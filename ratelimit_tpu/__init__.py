@@ -1,0 +1,27 @@
+"""tpu-ratelimit: a TPU-native rate-limit decision service.
+
+A from-scratch rebuild of the capabilities of envoyproxy/ratelimit
+(reference at /root/reference) with the Redis/Memcached counter hot path
+replaced by a batched JAX/XLA counter engine holding a fixed-window
+counter table in TPU HBM.
+
+Layering (mirrors reference src/ layering, SURVEY.md section 1):
+
+- ``api``       -- the rls.proto data model (request/response/enums).
+- ``utils``     -- time source, unit->divider, reset math.
+- ``config``    -- YAML -> descriptor-trie limit config + GetLimit walk.
+- ``limiter``   -- cache-key generation, threshold state machine,
+                   local over-limit cache, the RateLimitCache seam.
+- ``ops``       -- JAX/Pallas kernels: the fixed-window counter engine.
+- ``models``    -- the "flagship model": fixed-window decision model
+                   (counter state + jittable decision step).
+- ``backends``  -- RateLimitCache implementations (tpu, memory).
+- ``parallel``  -- mesh-sharded multi-chip counter engine.
+- ``service``   -- ShouldRateLimit service logic (aggregate codes,
+                   headers, shadow modes, hot reload).
+- ``server``    -- gRPC + JSON/HTTP + health/debug serving surfaces.
+- ``stats``     -- counter tree + statsd export.
+- ``runtime``   -- config directory watcher.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,14 @@
+from .cache import RateLimitCache
+from .cache_key import CacheKey, CacheKeyGenerator
+from .base import LimitDecision, decide, decide_batch
+from .local_cache import LocalCache
+
+__all__ = [
+    "RateLimitCache",
+    "CacheKey",
+    "CacheKeyGenerator",
+    "LimitDecision",
+    "decide",
+    "decide_batch",
+    "LocalCache",
+]

@@ -1,0 +1,60 @@
+"""Cache-key generation.
+
+Key layout is wire-compatible with the reference
+(src/limiter/cache_key.go:48-80):
+
+    <prefix><domain>_<key>_<value>_..._<window_start>
+
+where entries with empty values still contribute a trailing underscore
+(``key__``), and ``window_start = (now // divider) * divider``.  A key is
+the identity of one (descriptor, window) counter; a new window produces a
+brand-new key, which is how fixed windows "expire" without TTLs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..api import Descriptor, Unit
+from ..config import RateLimitRule
+from ..utils.time import unit_to_divider
+
+
+@dataclass(frozen=True)
+class CacheKey:
+    key: str
+    # True when the limit's unit is SECOND; routes to the dedicated
+    # per-second counter bank (dual-Redis analog, cache_key.go:34-40).
+    per_second: bool
+
+
+EMPTY_KEY = CacheKey("", False)
+
+
+class CacheKeyGenerator:
+    def __init__(self, prefix: str = ""):
+        self.prefix = prefix
+
+    def generate(
+        self, domain: str, descriptor: Descriptor, rule: Optional[RateLimitRule], now: int
+    ) -> CacheKey:
+        """Build the counter key for one descriptor at time `now`.
+
+        Returns an empty key for descriptors with no matching rule so
+        result arrays stay index-aligned with the request
+        (cache_key.go:51-56).
+        """
+        if rule is None:
+            return EMPTY_KEY
+        unit = rule.limit.unit
+        divider = unit_to_divider(unit)
+        window = (now // divider) * divider
+        parts = [self.prefix, domain, "_"]
+        for entry in descriptor.entries:
+            parts.append(entry.key)
+            parts.append("_")
+            parts.append(entry.value)
+            parts.append("_")
+        parts.append(str(window))
+        return CacheKey("".join(parts), unit == Unit.SECOND)

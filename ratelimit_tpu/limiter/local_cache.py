@@ -1,0 +1,66 @@
+"""Host-side over-limit cache.
+
+The reference keeps a freecache LRU of keys already known to be over
+their limit so repeat offenders never touch Redis
+(src/limiter/base_limiter.go:63-72,103-115).  Here it shields the
+device batch path the same way: a key that went over-limit is cached
+with TTL = the full window length, and subsequent hits on it are
+decided host-side without occupying batch slots.
+
+freecache is byte-budgeted; we approximate the
+``LOCAL_CACHE_SIZE_IN_BYTES`` knob by dividing by an assumed ~64 bytes
+per entry and evicting in FIFO order (entries all expire within one
+window, so FIFO ~= LRU here).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional
+
+from ..stats.manager import StatsStore
+
+APPROX_ENTRY_BYTES = 64
+
+
+class LocalCache:
+    def __init__(self, size_bytes: int, clock=None):
+        self.max_entries = max(1, size_bytes // APPROX_ENTRY_BYTES)
+        self._entries: "OrderedDict[str, float]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._clock = clock or time.monotonic
+
+    def contains(self, key: str) -> bool:
+        """True if `key` is cached and unexpired
+        (base_limiter.go:63-72)."""
+        now = self._clock()
+        with self._lock:
+            expiry = self._entries.get(key)
+            if expiry is None:
+                return False
+            if expiry <= now:
+                del self._entries[key]
+                return False
+            return True
+
+    def set(self, key: str, ttl_seconds: int) -> None:
+        """Cache `key` for `ttl_seconds` (the unit's full window,
+        base_limiter.go:103-115)."""
+        now = self._clock()
+        with self._lock:
+            self._entries[key] = now + ttl_seconds
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def register_stats(self, store: StatsStore, scope: str = "ratelimit.localcache") -> None:
+        """Expose freecache-style gauges, re-read at every stats
+        snapshot like the reference's StatGenerator (reference
+        src/limiter/local_cache_stats.go)."""
+        store.gauge_fn(scope + ".entryCount", lambda: len(self))

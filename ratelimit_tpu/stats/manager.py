@@ -1,0 +1,205 @@
+"""Stat tree with reference-compatible names.
+
+Mirrors reference src/stats/manager.go + manager_impl.go.  The scope
+layout (manager_impl.go:10-18) is::
+
+    ratelimit.service.rate_limit.<rule key>.{total_hits,over_limit,
+        near_limit,over_limit_with_local_cache,within_limit,shadow_mode}
+    ratelimit.service.{config_load_success,config_load_error,global_shadow_mode}
+    ratelimit.service.call.should_rate_limit.{redis_error,service_error}
+
+``redis_error`` keeps its reference name (tests in the reference assert
+it; here it counts TPU-engine/backend failures).  Counters are
+monotonically increasing with thread-safe ``add``; a sink (statsd or
+null) drains deltas periodically (``ratelimit_tpu.stats.sink``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class Counter:
+    """A monotonically increasing, thread-safe counter."""
+
+    __slots__ = ("name", "_value", "_lock", "_last_flushed")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._last_flushed = 0
+        self._lock = threading.Lock()
+
+    def add(self, delta: int) -> None:
+        if delta:
+            with self._lock:
+                self._value += int(delta)
+
+    def inc(self) -> None:
+        self.add(1)
+
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+    def drain_delta(self) -> int:
+        """Value accumulated since the last drain (for statsd export)."""
+        with self._lock:
+            delta = self._value - self._last_flushed
+            self._last_flushed = self._value
+            return delta
+
+
+class Gauge:
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def set(self, value: int) -> None:
+        with self._lock:
+            self._value = int(value)
+
+    def add(self, delta: int) -> None:
+        with self._lock:
+            self._value += int(delta)
+
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class StatsStore:
+    """Flat name -> Counter/Gauge registry; idempotent creation."""
+
+    def __init__(self):
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._gauge_fns: Dict[str, "callable"] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name)
+            return c
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name)
+            return g
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return {name: c.value() for name, c in self._counters.items()}
+
+    def gauge_fn(self, name: str, fn) -> None:
+        """Register a live gauge evaluated at snapshot time (reference
+        gostats StatGenerator pattern, local_cache_stats.go)."""
+        with self._lock:
+            self._gauge_fns[name] = fn
+
+    def gauges(self) -> Dict[str, int]:
+        with self._lock:
+            out = {name: g.value() for name, g in self._gauges.items()}
+            fns = list(self._gauge_fns.items())
+        for name, fn in fns:
+            out[name] = int(fn())
+        return out
+
+    def snapshot(self) -> Dict[str, int]:
+        out = self.counters()
+        out.update(self.gauges())
+        return out
+
+
+class RateLimitStats:
+    """Per-rule counters (reference manager_impl.go:27-38)."""
+
+    __slots__ = (
+        "key",
+        "total_hits",
+        "over_limit",
+        "near_limit",
+        "over_limit_with_local_cache",
+        "within_limit",
+        "shadow_mode",
+    )
+
+    def __init__(self, scope_prefix: str, key: str, store: StatsStore):
+        self.key = key
+        base = f"{scope_prefix}.{key}"
+        self.total_hits = store.counter(base + ".total_hits")
+        self.over_limit = store.counter(base + ".over_limit")
+        self.near_limit = store.counter(base + ".near_limit")
+        self.over_limit_with_local_cache = store.counter(
+            base + ".over_limit_with_local_cache"
+        )
+        self.within_limit = store.counter(base + ".within_limit")
+        self.shadow_mode = store.counter(base + ".shadow_mode")
+
+
+class ShouldRateLimitStats:
+    """Panic-recovery counters (reference manager_impl.go:40-45)."""
+
+    __slots__ = ("redis_error", "service_error")
+
+    def __init__(self, scope: str, store: StatsStore):
+        self.redis_error = store.counter(scope + ".redis_error")
+        self.service_error = store.counter(scope + ".service_error")
+
+
+class ServiceStats:
+    """Service-level counters (reference manager_impl.go:47-54)."""
+
+    __slots__ = (
+        "config_load_success",
+        "config_load_error",
+        "should_rate_limit",
+        "global_shadow_mode",
+    )
+
+    def __init__(self, scope: str, store: StatsStore):
+        self.config_load_success = store.counter(scope + ".config_load_success")
+        self.config_load_error = store.counter(scope + ".config_load_error")
+        self.should_rate_limit = ShouldRateLimitStats(
+            scope + ".call.should_rate_limit", store
+        )
+        self.global_shadow_mode = store.counter(scope + ".global_shadow_mode")
+
+
+class Manager:
+    """Owner of the stat scopes (reference stats.Manager seam)."""
+
+    def __init__(self, store: Optional[StatsStore] = None, extra_tags: Optional[Dict[str, str]] = None):
+        self.store = store or StatsStore()
+        # gostats ScopeWithTags folds tags into the scope; we suffix the
+        # root scope name with sorted tag pairs for the same effect.
+        root = "ratelimit"
+        if extra_tags:
+            root += "".join(f".__{k}={v}" for k, v in sorted(extra_tags.items()))
+        self.service_scope = root + ".service"
+        self.rl_scope = self.service_scope + ".rate_limit"
+        self._rule_stats: Dict[str, RateLimitStats] = {}
+        self._lock = threading.Lock()
+
+    def rate_limit_stats(self, key: str) -> RateLimitStats:
+        """Per-rule stats; equivalent calls return the same counters
+        (reference manager.go:11-12)."""
+        with self._lock:
+            s = self._rule_stats.get(key)
+            if s is None:
+                s = self._rule_stats[key] = RateLimitStats(self.rl_scope, key, self.store)
+            return s
+
+    # Reference-parity alias (manager_impl.go NewStats).
+    new_stats = rate_limit_stats
+
+    def service_stats(self) -> ServiceStats:
+        return ServiceStats(self.service_scope, self.store)

@@ -1,0 +1,15 @@
+from .time import (
+    MonotonicBatchClock,
+    RealTimeSource,
+    TimeSource,
+    calculate_reset,
+    unit_to_divider,
+)
+
+__all__ = [
+    "TimeSource",
+    "RealTimeSource",
+    "MonotonicBatchClock",
+    "unit_to_divider",
+    "calculate_reset",
+]

@@ -1,0 +1,73 @@
+"""Time sources and fixed-window math.
+
+Mirrors reference src/utils/utilities.go and src/utils/time.go:
+``UnitToDivider`` (utilities.go:17-30), ``CalculateReset``
+(utilities.go:32-36), and the ``TimeSource`` seam (utilities.go:9-12)
+that lets tests pin the clock.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..api import Unit
+
+_DIVIDERS = {
+    Unit.SECOND: 1,
+    Unit.MINUTE: 60,
+    Unit.HOUR: 60 * 60,
+    Unit.DAY: 60 * 60 * 24,
+}
+
+
+def unit_to_divider(unit: Unit) -> int:
+    """Length of the fixed window, in seconds, for a limit unit."""
+    try:
+        return _DIVIDERS[Unit(unit)]
+    except KeyError:
+        raise ValueError(f"unknown rate limit unit: {unit!r}") from None
+
+
+def calculate_reset(unit: Unit, time_source: "TimeSource") -> int:
+    """Seconds until the current window for `unit` rolls over."""
+    divider = unit_to_divider(unit)
+    return divider - time_source.unix_now() % divider
+
+
+def window_start(now: int, unit: Unit) -> int:
+    """Start timestamp of the fixed window containing `now`
+    (the ``(now/divider)*divider`` of reference cache_key.go:74)."""
+    divider = unit_to_divider(unit)
+    return (now // divider) * divider
+
+
+class TimeSource:
+    """Clock seam: tests substitute a pinned implementation."""
+
+    def unix_now(self) -> int:
+        raise NotImplementedError
+
+
+class RealTimeSource(TimeSource):
+    def unix_now(self) -> int:
+        return int(time.time())
+
+
+class MonotonicBatchClock(TimeSource):
+    """A time source snapshotted once per batch.
+
+    The batched engine evaluates a whole descriptor batch at one
+    logical timestamp so all keys in the batch share a consistent
+    window; the dispatcher snapshots this clock at batch assembly.
+    """
+
+    def __init__(self, base: TimeSource | None = None):
+        self._base = base or RealTimeSource()
+        self._now = self._base.unix_now()
+
+    def snapshot(self) -> int:
+        self._now = self._base.unix_now()
+        return self._now
+
+    def unix_now(self) -> int:
+        return self._now

@@ -1,0 +1,42 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
+
+Tests never require real TPU hardware; sharded-engine tests use
+8 virtual CPU devices (mirrors how the reference tests run against
+local redis processes instead of production clusters).
+Must run before anything imports jax.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+from ratelimit_tpu.stats.manager import Manager  # noqa: E402
+from ratelimit_tpu.utils.time import TimeSource  # noqa: E402
+
+
+class FakeTimeSource(TimeSource):
+    """Pinned clock (reference test MockClock pattern,
+    test/service/ratelimit_test.go:72-76)."""
+
+    def __init__(self, now: int = 0):
+        self.now = now
+
+    def unix_now(self) -> int:
+        return self.now
+
+
+@pytest.fixture
+def clock():
+    return FakeTimeSource(1234)
+
+
+@pytest.fixture
+def stats_manager():
+    return Manager()

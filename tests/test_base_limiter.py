@@ -1,0 +1,149 @@
+"""Threshold state machine tests.
+
+Mirrors reference test/limiter/base_limiter_test.go:21-231 scenarios,
+and additionally locks the scalar and vectorized implementations
+together on randomized inputs.
+"""
+
+import numpy as np
+import pytest
+
+from ratelimit_tpu.api import Code
+from ratelimit_tpu.limiter.base import (
+    decide,
+    decide_batch,
+    near_limit_threshold,
+)
+
+
+def test_near_limit_threshold_float32_floor():
+    # base_limiter.go:94 computes in float32.
+    assert near_limit_threshold(10, 0.8) == 8
+    assert near_limit_threshold(15, 0.8) == 12
+    assert near_limit_threshold(1, 0.8) == 0
+    assert near_limit_threshold(0, 0.8) == 0
+
+
+def test_within_limit():
+    d = decide(limit=10, before=4, after=5, hits=1, near_ratio=0.8)
+    assert d.code == Code.OK
+    assert d.limit_remaining == 5
+    assert d.within_limit == 1
+    assert d.near_limit == 0 and d.over_limit == 0
+    assert not d.set_local_cache
+
+
+def test_exactly_at_limit_is_ok():
+    # Over-limit requires after > limit (base_limiter.go:96).
+    d = decide(limit=10, before=9, after=10, hits=1, near_ratio=0.8)
+    assert d.code == Code.OK
+    assert d.limit_remaining == 0
+    assert d.near_limit == 1  # 10 > 8 and before 9 >= 8 -> all hits near
+
+
+def test_near_limit_partial_attribution():
+    # before=6 < near=8, after=9: only 9-8=1 hit is "near".
+    d = decide(limit=10, before=6, after=9, hits=3, near_ratio=0.8)
+    assert d.code == Code.OK
+    assert d.near_limit == 1
+    assert d.within_limit == 3
+
+
+def test_over_limit_fully():
+    d = decide(limit=10, before=11, after=12, hits=1, near_ratio=0.8)
+    assert d.code == Code.OVER_LIMIT
+    assert d.limit_remaining == 0
+    assert d.over_limit == 1
+    assert d.near_limit == 0
+    assert d.set_local_cache
+
+
+def test_over_limit_partial_attribution():
+    # base_limiter.go:150-165: before=7, after=13, limit=10, near=8:
+    # over_limit += 13-10=3; near_limit += 10-max(8,7)=2.
+    d = decide(limit=10, before=7, after=13, hits=6, near_ratio=0.8)
+    assert d.code == Code.OVER_LIMIT
+    assert d.over_limit == 3
+    assert d.near_limit == 2
+    assert d.within_limit == 0
+
+
+def test_local_cache_short_circuit():
+    d = decide(
+        limit=10, before=0, after=0, hits=2, near_ratio=0.8,
+        over_limit_with_local_cache=True,
+    )
+    assert d.code == Code.OVER_LIMIT
+    assert d.over_limit == 2
+    assert d.over_limit_with_local_cache == 2
+    assert not d.set_local_cache
+
+
+def test_shadow_mode_forces_ok_but_counts():
+    d = decide(limit=10, before=11, after=12, hits=1, near_ratio=0.8, shadow_mode=True)
+    assert d.code == Code.OK
+    assert d.over_limit == 1
+    assert d.shadow_mode == 1
+
+
+def test_shadow_mode_within_limit_no_shadow_stat():
+    d = decide(limit=10, before=1, after=2, hits=1, near_ratio=0.8, shadow_mode=True)
+    assert d.code == Code.OK
+    assert d.shadow_mode == 0
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_batch_matches_scalar(seed):
+    rng = np.random.default_rng(seed)
+    n = 512
+    limits = rng.integers(1, 50, n)
+    hits = rng.integers(1, 10, n)
+    befores = rng.integers(0, 60, n)
+    afters = befores + hits
+    shadow = rng.random(n) < 0.3
+    lc = rng.random(n) < 0.2
+
+    batch = decide_batch(limits, befores, afters, hits, 0.8, shadow, lc)
+    for i in range(n):
+        scalar = decide(
+            int(limits[i]), int(befores[i]), int(afters[i]), int(hits[i]), 0.8,
+            shadow_mode=bool(shadow[i]), over_limit_with_local_cache=bool(lc[i]),
+        )
+        assert batch.codes[i] == int(scalar.code), i
+        assert batch.limit_remaining[i] == scalar.limit_remaining, i
+        assert batch.over_limit[i] == scalar.over_limit, i
+        assert batch.near_limit[i] == scalar.near_limit, i
+        assert batch.within_limit[i] == scalar.within_limit, i
+        assert batch.over_limit_with_local_cache[i] == scalar.over_limit_with_local_cache, i
+        assert batch.shadow_mode[i] == scalar.shadow_mode, i
+        assert batch.set_local_cache[i] == scalar.set_local_cache, i
+
+
+def test_local_cache_ttl_and_eviction():
+    from ratelimit_tpu.limiter.local_cache import LocalCache
+
+    t = [0.0]
+    cache = LocalCache(size_bytes=64 * 2, clock=lambda: t[0])
+    cache.set("a", ttl_seconds=10)
+    assert cache.contains("a")
+    t[0] = 11.0
+    assert not cache.contains("a")
+    # Eviction at capacity (2 entries).
+    cache.set("x", 100)
+    cache.set("y", 100)
+    cache.set("z", 100)
+    assert len(cache) == 2
+    assert not cache.contains("x")
+    assert cache.contains("z")
+
+
+def test_local_cache_live_gauge():
+    from ratelimit_tpu.limiter.local_cache import LocalCache
+    from ratelimit_tpu.stats.manager import StatsStore
+
+    store = StatsStore()
+    cache = LocalCache(size_bytes=6400)
+    cache.register_stats(store)
+    assert store.gauges()["ratelimit.localcache.entryCount"] == 0
+    cache.set("k", 100)
+    assert store.gauges()["ratelimit.localcache.entryCount"] == 1

@@ -1,0 +1,60 @@
+from ratelimit_tpu.api import Descriptor, RateLimit, Unit
+from ratelimit_tpu.config import RateLimitRule
+from ratelimit_tpu.limiter.cache_key import CacheKeyGenerator
+from ratelimit_tpu.stats.manager import Manager
+
+
+def make_rule(requests_per_unit=10, unit=Unit.SECOND, key="domain.key_value"):
+    m = Manager()
+    return RateLimitRule(
+        full_key=key,
+        limit=RateLimit(requests_per_unit, unit),
+        stats=m.rate_limit_stats(key),
+    )
+
+
+def test_no_rule_gives_empty_key():
+    # cache_key.go:51-56
+    gen = CacheKeyGenerator()
+    ck = gen.generate("domain", Descriptor.of(("key", "value")), None, 1234)
+    assert ck.key == ""
+    assert not ck.per_second
+
+
+def test_key_layout_second():
+    # cache_key.go:62-74: domain_key_value_<windowstart>
+    gen = CacheKeyGenerator()
+    ck = gen.generate(
+        "domain", Descriptor.of(("key", "value")), make_rule(unit=Unit.SECOND), 1234
+    )
+    assert ck.key == "domain_key_value_1234"
+    assert ck.per_second
+
+
+def test_key_layout_minute_window_aligned():
+    # reference test/redis/fixed_cache_impl_test.go expects "..._1200"
+    # for MINUTE at now=1234.
+    gen = CacheKeyGenerator()
+    ck = gen.generate(
+        "domain", Descriptor.of(("key", "value")), make_rule(unit=Unit.MINUTE), 1234
+    )
+    assert ck.key == "domain_key_value_1200"
+    assert not ck.per_second
+
+
+def test_key_multiple_entries_and_empty_value():
+    gen = CacheKeyGenerator()
+    ck = gen.generate(
+        "d",
+        Descriptor.of(("k1", "v1"), ("k2", "")),
+        make_rule(unit=Unit.HOUR),
+        7200,
+    )
+    assert ck.key == "d_k1_v1_k2__7200"
+
+
+def test_prefix():
+    # CACHE_KEY_PREFIX knob (settings.go:49)
+    gen = CacheKeyGenerator(prefix="pfx:")
+    ck = gen.generate("d", Descriptor.of(("k", "v")), make_rule(), 5)
+    assert ck.key == "pfx:d_k_v_5"

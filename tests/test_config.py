@@ -1,0 +1,222 @@
+"""Config loader tests.
+
+Scenario coverage mirrors the reference's 13 YAML fixtures driven by
+test/config/config_test.go (basic lookup semantics, duplicate
+domain/key, empty key/domain, bad unit, unknown keys, non-map lists,
+unlimited-with-unit exclusivity, shadow_mode), with fixtures authored
+fresh for this repo.
+"""
+
+import pytest
+
+from ratelimit_tpu.api import Descriptor, LimitOverride, Unit
+from ratelimit_tpu.config import ConfigError, ConfigFile, load_config
+from ratelimit_tpu.stats.manager import Manager
+
+BASIC = """
+domain: test-domain
+descriptors:
+  - key: key1
+    value: value1
+    rate_limit:
+      unit: minute
+      requests_per_unit: 20
+    descriptors:
+      - key: subkey1
+        descriptors:
+          - key: subsubkey1
+            rate_limit:
+              unit: hour
+              requests_per_unit: 30
+  - key: key2
+    rate_limit:
+      unit: second
+      requests_per_unit: 50
+  - key: key3
+    rate_limit:
+      unit: day
+      requests_per_unit: 70
+  - key: key4
+    rate_limit:
+      unlimited: true
+  - key: key5
+    shadow_mode: true
+    rate_limit:
+      unit: second
+      requests_per_unit: 10
+"""
+
+
+def load(*contents, manager=None):
+    files = [ConfigFile(f"file{i}.yaml", c) for i, c in enumerate(contents)]
+    return load_config(files, manager or Manager())
+
+
+def test_basic_lookup():
+    cfg = load(BASIC)
+    rule = cfg.get_limit("test-domain", Descriptor.of(("key1", "value1")))
+    assert rule is not None
+    assert rule.limit.requests_per_unit == 20
+    assert rule.limit.unit == Unit.MINUTE
+    assert rule.full_key == "test-domain.key1_value1"
+    assert not rule.shadow_mode
+
+
+def test_unknown_domain_and_descriptor():
+    cfg = load(BASIC)
+    assert cfg.get_limit("nope", Descriptor.of(("key1", "value1"))) is None
+    assert cfg.get_limit("test-domain", Descriptor.of(("nope", "x"))) is None
+
+
+def test_wildcard_key_fallback():
+    # key2 has no value: matches any value (config_impl.go:268-278).
+    cfg = load(BASIC)
+    for v in ("a", "b"):
+        rule = cfg.get_limit("test-domain", Descriptor.of(("key2", v)))
+        assert rule is not None and rule.limit.requests_per_unit == 50
+
+
+def test_depth_must_match():
+    # A rule only applies at the final entry (config_impl.go:280-287).
+    cfg = load(BASIC)
+    # Deeper request than config depth for key1_value1 -> key1 rule does
+    # NOT apply at depth 2 (no rule at subkey1 level).
+    assert (
+        cfg.get_limit(
+            "test-domain", Descriptor.of(("key1", "value1"), ("subkey1", "x"))
+        )
+        is None
+    )
+    # Exact 3-deep nested rule resolves.
+    rule = cfg.get_limit(
+        "test-domain",
+        Descriptor.of(("key1", "value1"), ("subkey1", "anything"), ("subsubkey1", "v")),
+    )
+    assert rule is not None and rule.limit.unit == Unit.HOUR
+
+
+def test_unlimited_rule():
+    cfg = load(BASIC)
+    rule = cfg.get_limit("test-domain", Descriptor.of(("key4", "")))
+    assert rule is not None
+    assert rule.unlimited
+    assert rule.limit.unit == Unit.UNKNOWN
+
+
+def test_shadow_mode_rule():
+    cfg = load(BASIC)
+    rule = cfg.get_limit("test-domain", Descriptor.of(("key5", "x")))
+    assert rule is not None and rule.shadow_mode
+
+
+def test_request_override_bypasses_trie():
+    # config_impl.go:254-265; override stat key uses dotted form and
+    # never inherits shadow mode.
+    cfg = load(BASIC)
+    desc = Descriptor.of(
+        ("key5", "x"), limit=LimitOverride(requests_per_unit=7, unit=Unit.DAY)
+    )
+    rule = cfg.get_limit("test-domain", desc)
+    assert rule is not None
+    assert rule.limit.requests_per_unit == 7
+    assert rule.limit.unit == Unit.DAY
+    assert not rule.shadow_mode
+    assert rule.full_key == "test-domain.key5_x"
+
+
+def test_multi_file_and_duplicate_domain():
+    cfg = load(BASIC, "domain: other\ndescriptors: [{key: k, rate_limit: {unit: second, requests_per_unit: 1}}]")
+    assert cfg.get_limit("other", Descriptor.of(("k", ""))) is not None
+    with pytest.raises(ConfigError, match="duplicate domain 'test-domain'"):
+        load(BASIC, BASIC)
+
+
+def test_empty_domain():
+    with pytest.raises(ConfigError, match="config file cannot have empty domain"):
+        load("domain: ''\ndescriptors: []")
+
+
+def test_empty_key():
+    with pytest.raises(ConfigError, match="descriptor has empty key"):
+        load("domain: d\ndescriptors: [{value: v}]")
+
+
+def test_duplicate_composite_key():
+    with pytest.raises(ConfigError, match="duplicate descriptor composite key 'd.k_v'"):
+        load(
+            """
+domain: d
+descriptors:
+  - key: k
+    value: v
+  - key: k
+    value: v
+"""
+        )
+
+
+def test_bad_unit():
+    with pytest.raises(ConfigError, match="invalid rate limit unit 'fortnight'"):
+        load("domain: d\ndescriptors: [{key: k, rate_limit: {unit: fortnight, requests_per_unit: 1}}]")
+
+
+def test_unlimited_with_unit_is_an_error():
+    # config_impl.go:126-131
+    with pytest.raises(ConfigError, match="should not specify rate limit unit when unlimited"):
+        load(
+            "domain: d\ndescriptors: [{key: k, rate_limit: {unlimited: true, unit: second, requests_per_unit: 1}}]"
+        )
+
+
+def test_unknown_yaml_key_rejected():
+    # strict whitelist (config_impl.go:156-196); typo detection.
+    with pytest.raises(ConfigError, match="config error, unknown key 'ratelimit'"):
+        load("domain: d\ndescriptors: [{key: k, ratelimit: {unit: second}}]")
+
+
+def test_nested_unknown_key_rejected():
+    with pytest.raises(ConfigError, match="unknown key 'requests_perunit'"):
+        load("domain: d\ndescriptors: [{key: k, rate_limit: {unit: second, requests_perunit: 1}}]")
+
+
+def test_list_of_non_map_rejected():
+    with pytest.raises(ConfigError, match="list of type other than map"):
+        load("domain: d\ndescriptors: [not-a-map]")
+
+
+def test_non_string_key_rejected():
+    with pytest.raises(ConfigError, match="key is not of type string"):
+        load("1: d")
+
+
+def test_bad_yaml_rejected():
+    with pytest.raises(ConfigError, match="error loading config file"):
+        load("domain: d\ndescriptors: [}{")
+
+
+def test_error_includes_file_name():
+    with pytest.raises(ConfigError, match=r"^file0\.yaml: "):
+        load("domain: ''")
+
+
+def test_stats_created_per_rule(stats_manager):
+    load(BASIC, manager=stats_manager)
+    names = stats_manager.store.counters().keys()
+    assert "ratelimit.service.rate_limit.test-domain.key1_value1.total_hits" in names
+    assert (
+        "ratelimit.service.rate_limit.test-domain.key1_value1.subkey1.subsubkey1.over_limit"
+        in names
+    )
+
+
+def test_dump_lists_rules():
+    cfg = load(BASIC)
+    dump = cfg.dump()
+    assert "test-domain.key1_value1: unit=MINUTE requests_per_unit=20" in dump
+    assert "shadow_mode: true" in dump
+
+
+def test_non_string_scalar_value_rejected():
+    # Reference's typed unmarshal rejects `value: 404` into a string field.
+    with pytest.raises(ConfigError, match="value must be a string"):
+        load("domain: d\ndescriptors: [{key: k, value: 404}]")

@@ -1,0 +1,48 @@
+import pytest
+
+from ratelimit_tpu.api import Unit
+from ratelimit_tpu.utils.time import (
+    MonotonicBatchClock,
+    calculate_reset,
+    unit_to_divider,
+    window_start,
+)
+
+
+def test_unit_to_divider():
+    # reference src/utils/utilities.go:17-30
+    assert unit_to_divider(Unit.SECOND) == 1
+    assert unit_to_divider(Unit.MINUTE) == 60
+    assert unit_to_divider(Unit.HOUR) == 3600
+    assert unit_to_divider(Unit.DAY) == 86400
+
+
+def test_unit_to_divider_unknown_raises():
+    with pytest.raises(ValueError):
+        unit_to_divider(Unit.UNKNOWN)
+
+
+def test_calculate_reset(clock):
+    # reference src/utils/utilities.go:32-36: divider - now % divider
+    clock.now = 1234
+    assert calculate_reset(Unit.SECOND, clock) == 1
+    assert calculate_reset(Unit.MINUTE, clock) == 60 - 34
+    assert calculate_reset(Unit.HOUR, clock) == 3600 - 1234
+    assert calculate_reset(Unit.DAY, clock) == 86400 - 1234
+
+
+def test_window_start():
+    assert window_start(1234, Unit.SECOND) == 1234
+    assert window_start(1234, Unit.MINUTE) == 1200
+    assert window_start(1234, Unit.HOUR) == 0
+    assert window_start(90000, Unit.DAY) == 86400
+
+
+def test_monotonic_batch_clock(clock):
+    batch_clock = MonotonicBatchClock(clock)
+    assert batch_clock.unix_now() == 1234
+    clock.now = 2000
+    # Frozen until snapshotted.
+    assert batch_clock.unix_now() == 1234
+    assert batch_clock.snapshot() == 2000
+    assert batch_clock.unix_now() == 2000
