@@ -1,0 +1,11 @@
+from .slot_table import SlotTable
+from .engine import CounterEngine
+from .tpu_cache import TpuRateLimitCache
+from .memory_cache import MemoryRateLimitCache
+
+__all__ = [
+    "SlotTable",
+    "CounterEngine",
+    "TpuRateLimitCache",
+    "MemoryRateLimitCache",
+]

@@ -1,0 +1,148 @@
+"""CounterEngine: host orchestration around the device model.
+
+Owns the counter table (a donated device buffer), the host slot table,
+and batch padding/bucketing.  One engine is one counter bank; the
+backend may run a second engine for per-second limits (the dual-Redis
+analog, reference fixed_cache_impl.go:77-87).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..models.fixed_window import DeviceBatch, DeviceDecisions, FixedWindowModel
+
+# Pad batches up to one of these sizes so XLA compiles a handful of
+# shapes instead of one per batch length (SURVEY.md section 2 SP row:
+# batch-axis bucketing to fixed kernel shapes).
+DEFAULT_BUCKETS = (8, 32, 128, 512, 1024, 2048, 4096)
+
+
+@dataclass
+class HostBatch:
+    """Unpadded batch assembled on the host (numpy, batch order)."""
+
+    slots: np.ndarray  # int32
+    hits: np.ndarray  # uint32
+    limits: np.ndarray  # uint32
+    fresh: np.ndarray  # bool
+    shadow: np.ndarray  # bool
+
+
+@dataclass
+class HostDecisions:
+    """Device decisions pulled back to host numpy, unpadded."""
+
+    codes: np.ndarray
+    limit_remaining: np.ndarray
+    befores: np.ndarray
+    afters: np.ndarray
+    over_limit: np.ndarray
+    near_limit: np.ndarray
+    within_limit: np.ndarray
+    shadow_mode: np.ndarray
+    set_local_cache: np.ndarray
+
+
+class CounterEngine:
+    def __init__(
+        self,
+        num_slots: int = 1 << 20,
+        near_ratio: float = 0.8,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        device: Optional[jax.Device] = None,
+    ):
+        from .slot_table import SlotTable
+
+        self.model = FixedWindowModel(num_slots, near_ratio)
+        self.slot_table = SlotTable(num_slots)
+        self.buckets = tuple(sorted(buckets))
+        self.max_batch = self.buckets[-1]
+        self._device = device
+        counts = self.model.init_state()
+        if device is not None:
+            counts = jax.device_put(counts, device)
+        self._counts = counts
+
+    # -- host-side key handling -----------------------------------------
+
+    def assign_slot(self, key: str, now: int, expiry: int):
+        return self.slot_table.assign(key, now, expiry)
+
+    def gc(self, now: int) -> int:
+        return self.slot_table.gc(now)
+
+    # -- device step ----------------------------------------------------
+
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.max_batch
+
+    def step(self, batch: HostBatch) -> HostDecisions:
+        """Run one padded device step per <=max_batch chunk."""
+        n = len(batch.slots)
+        if n == 0:
+            empty = np.zeros(0, dtype=np.int32)
+            return HostDecisions(*([empty] * 8), empty.astype(bool))
+        outs: List[HostDecisions] = []
+        for start in range(0, n, self.max_batch):
+            outs.append(self._step_chunk(batch, start, min(n - start, self.max_batch)))
+        if len(outs) == 1:
+            return outs[0]
+        return HostDecisions(
+            *(
+                np.concatenate([getattr(o, f) for o in outs])
+                for f in HostDecisions.__dataclass_fields__
+            )
+        )
+
+    def _step_chunk(self, batch: HostBatch, start: int, count: int) -> HostDecisions:
+        padded = self._bucket(count)
+        sl = np.full(padded, self.model.num_slots, dtype=np.int32)
+        hi = np.zeros(padded, dtype=np.uint32)
+        li = np.ones(padded, dtype=np.uint32)
+        fr = np.zeros(padded, dtype=bool)
+        sh = np.zeros(padded, dtype=bool)
+        end = start + count
+        sl[:count] = batch.slots[start:end]
+        hi[:count] = batch.hits[start:end]
+        li[:count] = batch.limits[start:end]
+        fr[:count] = batch.fresh[start:end]
+        sh[:count] = batch.shadow[start:end]
+
+        device_batch = DeviceBatch(
+            slots=jax.numpy.asarray(sl),
+            hits=jax.numpy.asarray(hi),
+            limits=jax.numpy.asarray(li),
+            fresh=jax.numpy.asarray(fr),
+            shadow=jax.numpy.asarray(sh),
+        )
+        self._counts, decisions = self.model.step(self._counts, device_batch)
+        host: DeviceDecisions = jax.device_get(decisions)
+        return HostDecisions(
+            codes=host.codes[:count],
+            limit_remaining=host.limit_remaining[:count],
+            befores=host.befores[:count],
+            afters=host.afters[:count],
+            over_limit=host.over_limit[:count],
+            near_limit=host.near_limit[:count],
+            within_limit=host.within_limit[:count],
+            shadow_mode=host.shadow_mode[:count],
+            set_local_cache=host.set_local_cache[:count].astype(bool),
+        )
+
+    def reset(self) -> None:
+        """Drop all counters and key assignments (tests)."""
+        from .slot_table import SlotTable
+
+        counts = self.model.init_state()
+        if self._device is not None:
+            counts = jax.device_put(counts, self._device)
+        self._counts = counts
+        self.slot_table = SlotTable(self.model.num_slots)

@@ -1,0 +1,109 @@
+"""Host-side cache-key -> HBM-slot assignment.
+
+Redis gives the reference an unbounded keyspace with TTL eviction for
+free; the TPU counter table is a fixed array, so the host owns the
+mapping.  Design (SURVEY.md section 7 "hard parts (a)"):
+
+- exact mapping via a dict (no hash-collision false sharing between
+  tenants);
+- keys embed their window start (cache_key.py), so each new window is
+  a new key and dead keys are reclaimed by expiry;
+- expiry = window end + optional jitter (the EXPIRATION_JITTER
+  analog, settings.go:46, fixed_cache_impl.go:71-74), tracked in a
+  lazy-deletion min-heap;
+- when the table fills and nothing has expired, the soonest-expiring
+  live key is evicted (its slot is zeroed on reuse via the batch's
+  ``fresh`` flag, so eviction merely forgives the remainder of that
+  key's window -- the same failure mode as Redis maxmemory eviction).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Dict, List, Tuple
+
+
+class SlotTable:
+    def __init__(self, num_slots: int):
+        self.num_slots = int(num_slots)
+        self._map: Dict[str, Tuple[int, int]] = {}  # key -> (slot, expiry)
+        self._free: List[int] = list(range(self.num_slots - 1, -1, -1))
+        self._heap: List[Tuple[int, str]] = []  # (expiry, key), lazy-deleted
+        self._pinned: set = set()  # keys in the batch being assembled
+        self._batch_active = False
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def assign(self, key: str, now: int, expiry: int) -> Tuple[int, bool]:
+        """Slot for `key`, allocating on first sight.
+
+        Returns ``(slot, fresh)``; ``fresh`` means the slot was just
+        (re)assigned and the device must zero it before adding.
+        """
+        entry = self._map.get(key)
+        if entry is not None:
+            return entry[0], False
+
+        if not self._free:
+            self.gc(now)
+        if not self._free:
+            self._evict_one()
+
+        slot = self._free.pop()
+        self._map[key] = (slot, expiry)
+        heapq.heappush(self._heap, (expiry, key))
+        if self._batch_active:
+            self._pinned.add(key)
+        return slot, True
+
+    def begin_batch(self) -> None:
+        """Start pinning: keys assigned until ``end_batch`` cannot be
+        evicted, so two live keys in one device batch never share a
+        slot."""
+        self._batch_active = True
+        self._pinned.clear()
+
+    def end_batch(self) -> None:
+        self._batch_active = False
+        self._pinned.clear()
+
+    def gc(self, now: int) -> int:
+        """Reclaim slots of expired keys; returns how many were freed."""
+        freed = 0
+        while self._heap and self._heap[0][0] <= now:
+            expiry, key = heapq.heappop(self._heap)
+            entry = self._map.get(key)
+            if entry is not None and entry[1] == expiry:
+                del self._map[key]
+                self._free.append(entry[0])
+                freed += 1
+        return freed
+
+    def _evict_one(self) -> None:
+        """Evict the soonest-expiring live key (table full, nothing
+        expired).  Keys pinned by the in-flight batch are skipped and
+        re-queued so a batch never self-collides."""
+        skipped: List[Tuple[int, str]] = []
+        try:
+            while self._heap:
+                expiry, key = heapq.heappop(self._heap)
+                entry = self._map.get(key)
+                if entry is None or entry[1] != expiry:
+                    continue  # lazy-deleted
+                if key in self._pinned:
+                    skipped.append((expiry, key))
+                    continue
+                del self._map[key]
+                self._free.append(entry[0])
+                self.evictions += 1
+                return
+        finally:
+            for item in skipped:
+                heapq.heappush(self._heap, item)
+        raise RuntimeError(
+            "slot table exhausted: batch holds more live keys than "
+            f"slots ({self.num_slots}); raise TPU_NUM_SLOTS above the "
+            "max batch size"
+        )

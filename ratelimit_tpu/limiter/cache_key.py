@@ -18,7 +18,7 @@ from typing import Optional
 
 from ..api import Descriptor, Unit
 from ..config import RateLimitRule
-from ..utils.time import unit_to_divider
+from ..utils.time import window_start
 
 
 @dataclass(frozen=True)
@@ -45,11 +45,14 @@ class CacheKeyGenerator:
         result arrays stay index-aligned with the request
         (cache_key.go:51-56).
         """
-        if rule is None:
+        if rule is None or rule.unlimited:
+            # Unlimited rules never reach a counter; the service layer
+            # answers them directly (reference ratelimit.go:140-144
+            # nils them out before DoLimit; guarded here too so the
+            # cache seam can't crash on Unit.UNKNOWN).
             return EMPTY_KEY
         unit = rule.limit.unit
-        divider = unit_to_divider(unit)
-        window = (now // divider) * divider
+        window = window_start(now, unit)
         parts = [self.prefix, domain, "_"]
         for entry in descriptor.entries:
             parts.append(entry.key)

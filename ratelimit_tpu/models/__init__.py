@@ -1,0 +1,15 @@
+from .fixed_window import (
+    DeviceBatch,
+    DeviceDecisions,
+    FixedWindowModel,
+    CODE_OK,
+    CODE_OVER_LIMIT,
+)
+
+__all__ = [
+    "DeviceBatch",
+    "DeviceDecisions",
+    "FixedWindowModel",
+    "CODE_OK",
+    "CODE_OVER_LIMIT",
+]

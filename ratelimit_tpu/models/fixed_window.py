@@ -1,0 +1,154 @@
+"""The flagship model: a fixed-window rate-limit decision step on TPU.
+
+This is the TPU-native replacement for the reference's Redis hot path
+(src/redis/fixed_cache_impl.go:33-113): where the reference issues a
+pipelined ``INCRBY key hits`` + ``EXPIRE`` per descriptor and decides
+from the returned counter, this model holds the counters as an int32
+table in HBM and evaluates an entire padded descriptor batch in ONE
+jitted step:
+
+    zero freshly-assigned slots  ->  gather 'before'  ->
+    in-batch per-slot prefix sums (Redis pipeline-order semantics)  ->
+    scatter-add hits  ->  threshold decisions + stat attribution
+
+Everything is static-shaped, branch-free XLA; the counts buffer is
+donated so the update is in-place in HBM.  Expiry is handled by the
+host slot table (keys embed their window start, so a new window is a
+new key and its first batch appearance carries ``fresh=1``, which
+zeroes the reused slot) -- the TPU analog of Redis TTL expiry
+(fixed_cache_impl.go:71-74).
+
+Threshold semantics mirror ``limiter.base`` exactly; the three
+implementations (scalar, numpy, this kernel) are locked together by
+tests/test_counter_model.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.prefix import per_slot_inclusive_prefix
+
+# api.Code values, as device-friendly constants (api.py Code enum).
+CODE_OK = 1
+CODE_OVER_LIMIT = 2
+
+
+class DeviceBatch(NamedTuple):
+    """One padded descriptor batch, ready for the device.
+
+    Padding/no-op entries use ``slot == num_slots`` (one past the
+    table); scatter/gather use drop/fill modes so they are inert.
+    """
+
+    slots: jax.Array  # int32[N] in [0, num_slots]; num_slots = inert
+    hits: jax.Array  # uint32[N]
+    limits: jax.Array  # uint32[N] requests_per_unit (full uint32 range)
+    fresh: jax.Array  # bool[N] first sighting of a newly assigned slot
+    shadow: jax.Array  # bool[N] rule-level shadow mode
+
+
+class DeviceDecisions(NamedTuple):
+    """Per-descriptor outcomes + stat deltas (codes int32, counters
+    uint32 -- matching the reference's uint32 counter domain)."""
+
+    codes: jax.Array  # CODE_OK / CODE_OVER_LIMIT
+    limit_remaining: jax.Array
+    befores: jax.Array  # counter before own hits (pipeline order)
+    afters: jax.Array  # counter after own hits
+    over_limit: jax.Array  # stat deltas, aggregated host-side per rule
+    near_limit: jax.Array
+    within_limit: jax.Array
+    shadow_mode: jax.Array
+    set_local_cache: jax.Array  # bool: first over-limit transition
+
+
+class FixedWindowModel:
+    """Configuration + jittable step for the counter table.
+
+    `num_slots` is the table capacity (one int32 per slot in HBM, so
+    2**24 slots = 64 MiB).  `near_ratio` is the NEAR_LIMIT_RATIO knob
+    (settings.go:48, default 0.8).
+    """
+
+    def __init__(self, num_slots: int, near_ratio: float = 0.8):
+        self.num_slots = int(num_slots)
+        self.near_ratio = float(near_ratio)
+
+    def init_state(self) -> jax.Array:
+        """Fresh counter table (all windows empty)."""
+        return jnp.zeros((self.num_slots,), dtype=jnp.uint32)
+
+    @functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def step(
+        self, counts: jax.Array, batch: DeviceBatch
+    ) -> Tuple[jax.Array, DeviceDecisions]:
+        """Evaluate one batch against the table; returns the updated
+        table (donated, in-place in HBM) and per-descriptor decisions."""
+        s = self.num_slots
+        slots = batch.slots
+        hits = batch.hits
+
+        # 1. Reset slots that were re-assigned to a new key this batch
+        #    (lazy expiry; the Redis-TTL analog).  Padded/stale entries
+        #    point at slot==s and are dropped.
+        fresh_idx = jnp.where(batch.fresh, slots, s)
+        counts = counts.at[fresh_idx].set(0, mode="drop")
+
+        # 2. Counter value before this batch touched the slot.
+        table_before = counts.at[slots].get(mode="fill", fill_value=0)
+
+        # 3. Redis-pipeline-order semantics for duplicate keys in one
+        #    batch: element i sees hits of earlier same-slot elements.
+        incl = per_slot_inclusive_prefix(slots, hits)
+        afters = table_before + incl
+        befores = afters - hits
+
+        # 4. Commit all hits (duplicates accumulate natively).
+        counts = counts.at[slots].add(hits, mode="drop")
+
+        # 5. Threshold state machine, branch-free (limiter/base.py
+        #    formulas; reference base_limiter.go:76-179).
+        limits = batch.limits
+        near = jnp.floor(
+            limits.astype(jnp.float32) * jnp.float32(self.near_ratio)
+        ).astype(jnp.uint32)
+
+        over = afters > limits
+        ok = ~over
+
+        fully_over = over & (befores >= limits)
+        partly_over = over & ~fully_over
+        over_delta = jnp.where(
+            fully_over, hits, jnp.where(partly_over, afters - limits, 0)
+        )
+        near_from_over = jnp.where(
+            partly_over, limits - jnp.maximum(near, befores), 0
+        )
+
+        near_ok = ok & (afters > near)
+        near_from_ok = jnp.where(
+            near_ok & (befores >= near),
+            hits,
+            jnp.where(near_ok, afters - near, 0),
+        )
+
+        shadowed = over & batch.shadow
+        codes = jnp.where(over & ~shadowed, CODE_OVER_LIMIT, CODE_OK)
+
+        decisions = DeviceDecisions(
+            codes=codes.astype(jnp.int32),
+            limit_remaining=jnp.where(ok, limits - afters, 0),
+            befores=befores,
+            afters=afters,
+            over_limit=over_delta,
+            near_limit=near_from_over + near_from_ok,
+            within_limit=jnp.where(ok, hits, 0),
+            shadow_mode=jnp.where(shadowed, hits, 0),
+            set_local_cache=over,
+        )
+        return counts, decisions

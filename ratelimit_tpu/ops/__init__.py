@@ -1,0 +1,3 @@
+from .prefix import per_slot_inclusive_prefix
+
+__all__ = ["per_slot_inclusive_prefix"]

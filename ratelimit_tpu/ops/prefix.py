@@ -1,0 +1,47 @@
+"""Segmented (per-slot) prefix sums for duplicate keys in one batch.
+
+The reference's Redis pipeline executes INCRBY commands sequentially,
+so when the same key appears k times in one batch, the i-th occurrence
+observes the counter *including* occurrences 0..i (one INCRBY each;
+fixed_cache_impl.go:28-31,100-103).  The batched engine reproduces that
+exactly: for each batch element, compute the inclusive sum of hits of
+*earlier* batch elements targeting the same slot, entirely with
+static-shaped XLA ops (sort + cumsum + segment-min), no data-dependent
+control flow.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def per_slot_inclusive_prefix(slots: jax.Array, hits: jax.Array) -> jax.Array:
+    """For each i: sum of hits[j] for j <= i with slots[j] == slots[i].
+
+    Both inputs are 1-D and equal length; returns the same shape/dtype
+    as `hits`.  Works under jit with static shapes.
+    """
+    n = slots.shape[0]
+    # Stable sort groups equal slots while preserving batch order
+    # within a group (jnp.argsort is stable), which is what gives
+    # "earlier in the batch" its meaning.
+    order = jnp.argsort(slots, stable=True)
+    sorted_hits = hits[order]
+    sorted_slots = slots[order]
+
+    csum = jnp.cumsum(sorted_hits)
+    excl = csum - sorted_hits  # global exclusive prefix
+
+    seg_start = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), sorted_slots[1:] != sorted_slots[:-1]]
+    )
+    seg_id = jnp.cumsum(seg_start) - 1
+    # excl is non-decreasing, so the minimum over a segment is its value
+    # at the segment start.
+    seg_base = jax.ops.segment_min(excl, seg_id, num_segments=n)
+    within_incl = excl - seg_base[seg_id] + sorted_hits
+
+    # Unsort back to batch order.
+    out = jnp.zeros_like(hits)
+    return out.at[order].set(within_incl)

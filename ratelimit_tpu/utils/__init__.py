@@ -3,7 +3,9 @@ from .time import (
     RealTimeSource,
     TimeSource,
     calculate_reset,
+    reset_seconds,
     unit_to_divider,
+    window_start,
 )
 
 __all__ = [
@@ -12,4 +14,6 @@ __all__ = [
     "MonotonicBatchClock",
     "unit_to_divider",
     "calculate_reset",
+    "reset_seconds",
+    "window_start",
 ]

@@ -28,10 +28,16 @@ def unit_to_divider(unit: Unit) -> int:
         raise ValueError(f"unknown rate limit unit: {unit!r}") from None
 
 
+def reset_seconds(unit: Unit, now: int) -> int:
+    """Seconds until the current window for `unit` rolls over
+    (reference CalculateReset, utilities.go:32-36)."""
+    divider = unit_to_divider(unit)
+    return divider - now % divider
+
+
 def calculate_reset(unit: Unit, time_source: "TimeSource") -> int:
     """Seconds until the current window for `unit` rolls over."""
-    divider = unit_to_divider(unit)
-    return divider - time_source.unix_now() % divider
+    return reset_seconds(unit, time_source.unix_now())
 
 
 def window_start(now: int, unit: Unit) -> int:

@@ -1,0 +1,269 @@
+"""Backend tests: TpuRateLimitCache scenarios mirroring the reference's
+test/redis/fixed_cache_impl_test.go, plus a randomized differential
+test locking the TPU backend to the exact in-memory backend.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from ratelimit_tpu.api import Code, Descriptor, RateLimitRequest, Unit
+from ratelimit_tpu.backends import (
+    CounterEngine,
+    MemoryRateLimitCache,
+    TpuRateLimitCache,
+)
+from ratelimit_tpu.config import ConfigFile, load_config
+from ratelimit_tpu.limiter.local_cache import LocalCache
+from ratelimit_tpu.stats.manager import Manager
+
+
+@pytest.fixture(scope="module")
+def shared_engine():
+    # One engine for the module: jit cache stays warm across tests;
+    # each test calls reset() for isolation.
+    return CounterEngine(num_slots=1 << 10, buckets=(8, 32))
+
+
+@pytest.fixture
+def engine(shared_engine):
+    shared_engine.reset()
+    return shared_engine
+
+
+def make_rule(manager, key="domain.key_value", rpu=10, unit=Unit.SECOND, shadow=False):
+    from ratelimit_tpu.api import RateLimit
+    from ratelimit_tpu.config import RateLimitRule
+
+    return RateLimitRule(
+        full_key=key,
+        limit=RateLimit(rpu, unit),
+        stats=manager.rate_limit_stats(key),
+        shadow_mode=shadow,
+    )
+
+
+def req(*descs, hits=0, domain="domain"):
+    return RateLimitRequest(domain, list(descs), hits)
+
+
+def stat_value(manager, key, which):
+    return manager.store.counter(
+        f"ratelimit.service.rate_limit.{key}.{which}"
+    ).value()
+
+
+def test_sequential_over_limit(engine, clock, stats_manager):
+    # 10/SECOND: hit 11 is over (integration_test.go over-limit loop).
+    cache = TpuRateLimitCache(engine, clock)
+    rule = make_rule(stats_manager)
+    desc = Descriptor.of(("key", "value"))
+    for i in range(10):
+        [st] = cache.do_limit(req(desc), [rule])
+        assert st.code == Code.OK, i
+        assert st.limit_remaining == 9 - i
+    [st] = cache.do_limit(req(desc), [rule])
+    assert st.code == Code.OVER_LIMIT
+    assert st.limit_remaining == 0
+    assert st.duration_until_reset == 1
+    assert stat_value(stats_manager, "domain.key_value", "total_hits") == 11
+    assert stat_value(stats_manager, "domain.key_value", "over_limit") == 1
+    assert stat_value(stats_manager, "domain.key_value", "within_limit") == 10
+
+
+def test_no_rule_gives_plain_ok(engine, clock):
+    cache = TpuRateLimitCache(engine, clock)
+    [st] = cache.do_limit(req(Descriptor.of(("k", "v"))), [None])
+    assert st.code == Code.OK
+    assert st.current_limit is None
+    assert st.duration_until_reset is None
+
+
+def test_window_rollover_resets(engine, clock, stats_manager):
+    cache = TpuRateLimitCache(engine, clock)
+    rule = make_rule(stats_manager, rpu=1, unit=Unit.SECOND)
+    desc = Descriptor.of(("key", "value"))
+    assert cache.do_limit(req(desc), [rule])[0].code == Code.OK
+    assert cache.do_limit(req(desc), [rule])[0].code == Code.OVER_LIMIT
+    clock.now += 1  # next window: new cache key, fresh slot
+    assert cache.do_limit(req(desc), [rule])[0].code == Code.OK
+
+
+def test_minute_window_duration(engine, clock, stats_manager):
+    clock.now = 1234
+    cache = TpuRateLimitCache(engine, clock)
+    rule = make_rule(stats_manager, rpu=10, unit=Unit.MINUTE)
+    [st] = cache.do_limit(req(Descriptor.of(("key", "value"))), [rule])
+    assert st.duration_until_reset == 60 - 34
+
+
+def test_hits_addend(engine, clock, stats_manager):
+    cache = TpuRateLimitCache(engine, clock)
+    rule = make_rule(stats_manager, rpu=10)
+    desc = Descriptor.of(("key", "value"))
+    [st] = cache.do_limit(req(desc, hits=7), [rule])
+    assert st.code == Code.OK and st.limit_remaining == 3
+    [st] = cache.do_limit(req(desc, hits=6), [rule])
+    # before=7 < 10, after=13 > 10: partial attribution.
+    assert st.code == Code.OVER_LIMIT
+    assert stat_value(stats_manager, "domain.key_value", "over_limit") == 3
+    assert stat_value(stats_manager, "domain.key_value", "near_limit") == 2
+
+
+def test_multi_descriptor_one_request(engine, clock, stats_manager):
+    cache = TpuRateLimitCache(engine, clock)
+    r1 = make_rule(stats_manager, key="domain.a", rpu=1)
+    r2 = make_rule(stats_manager, key="domain.b", rpu=10)
+    d1, d2 = Descriptor.of(("a", "x")), Descriptor.of(("b", "y"))
+    sts = cache.do_limit(req(d1, d2), [r1, r2])
+    assert [s.code for s in sts] == [Code.OK, Code.OK]
+    sts = cache.do_limit(req(d1, d2), [r1, r2])
+    assert [s.code for s in sts] == [Code.OVER_LIMIT, Code.OK]
+
+
+def test_local_cache_short_circuits_engine(engine, clock, stats_manager):
+    lc = LocalCache(size_bytes=1 << 16)
+    cache = TpuRateLimitCache(engine, clock, local_cache=lc)
+    rule = make_rule(stats_manager, rpu=1, unit=Unit.MINUTE, key="domain.lc")
+    desc = Descriptor.of(("lc", ""))
+    cache.do_limit(req(desc), [rule])
+    [st] = cache.do_limit(req(desc), [rule])  # engine says over; cached
+    assert st.code == Code.OVER_LIMIT
+    assert len(lc) == 1
+    [st] = cache.do_limit(req(desc), [rule])  # served from local cache
+    assert st.code == Code.OVER_LIMIT
+    assert stat_value(stats_manager, "domain.lc", "over_limit_with_local_cache") == 1
+    assert stat_value(stats_manager, "domain.lc", "over_limit") == 2
+
+
+def test_shadow_with_local_cache_skips_counter(engine, clock, stats_manager):
+    # fixed_cache_impl.go:57-67: shadow rule + cached over-limit key ->
+    # skip increment, report OK/full remaining.
+    lc = LocalCache(size_bytes=1 << 16)
+    cache = TpuRateLimitCache(engine, clock, local_cache=lc)
+    rule = make_rule(stats_manager, rpu=1, key="domain.sh", shadow=True)
+    desc = Descriptor.of(("sh", ""))
+    cache.do_limit(req(desc), [rule])
+    [st] = cache.do_limit(req(desc), [rule])  # over -> OK (shadow), cached
+    assert st.code == Code.OK
+    assert stat_value(stats_manager, "domain.sh", "shadow_mode") == 1
+    [st] = cache.do_limit(req(desc), [rule])
+    assert st.code == Code.OK
+    assert st.limit_remaining == 1
+    assert stat_value(stats_manager, "domain.sh", "within_limit") == 2
+
+
+def test_per_second_bank_routing(clock, stats_manager):
+    main = CounterEngine(num_slots=128, buckets=(8,))
+    per_second = CounterEngine(num_slots=128, buckets=(8,))
+    cache = TpuRateLimitCache(main, clock, per_second_engine=per_second)
+    rs = make_rule(stats_manager, key="domain.s", rpu=5, unit=Unit.SECOND)
+    rm = make_rule(stats_manager, key="domain.m", rpu=5, unit=Unit.MINUTE)
+    cache.do_limit(
+        req(Descriptor.of(("s", "")), Descriptor.of(("m", ""))), [rs, rm]
+    )
+    assert len(per_second.slot_table) == 1
+    assert len(main.slot_table) == 1
+
+
+def test_differential_tpu_vs_memory(clock):
+    """Randomized traffic: the TPU backend must agree exactly with the
+    in-memory oracle on codes, remaining, and per-rule stats."""
+    yaml = """
+domain: diff
+descriptors:
+  - key: a
+    rate_limit: {unit: second, requests_per_unit: 3}
+  - key: b
+    value: vb
+    shadow_mode: true
+    rate_limit: {unit: minute, requests_per_unit: 5}
+  - key: c
+    rate_limit: {unit: hour, requests_per_unit: 20}
+"""
+    m_tpu, m_mem = Manager(), Manager()
+    cfg_tpu = load_config([ConfigFile("d.yaml", yaml)], m_tpu)
+    cfg_mem = load_config([ConfigFile("d.yaml", yaml)], m_mem)
+    engine = CounterEngine(num_slots=256, buckets=(8, 32))
+    tpu = TpuRateLimitCache(engine, clock)
+    mem = MemoryRateLimitCache(clock)
+
+    rng = random.Random(42)
+    descs_pool = [
+        Descriptor.of(("a", str(i))) for i in range(3)
+    ] + [Descriptor.of(("b", "vb")), Descriptor.of(("c", "z")), Descriptor.of(("nope", "q"))]
+
+    for step in range(60):
+        k = rng.randint(1, 4)
+        descs = [rng.choice(descs_pool) for _ in range(k)]
+        hits = rng.randint(0, 3)
+        r = RateLimitRequest("diff", descs, hits)
+        lt = [cfg_tpu.get_limit("diff", d) for d in descs]
+        lm = [cfg_mem.get_limit("diff", d) for d in descs]
+        st_t = tpu.do_limit(r, lt)
+        st_m = mem.do_limit(RateLimitRequest("diff", descs, hits), lm)
+        for a, b in zip(st_t, st_m):
+            assert a.code == b.code, step
+            assert a.limit_remaining == b.limit_remaining, step
+            assert a.duration_until_reset == b.duration_until_reset, step
+        if rng.random() < 0.3:
+            clock.now += rng.randint(1, 40)
+
+    assert m_tpu.store.counters() == m_mem.store.counters()
+
+
+def test_unlimited_rule_does_not_crash_backends(engine, clock, stats_manager):
+    # Unlimited rules are answered by the service layer; the cache seam
+    # must tolerate them (no Unit.UNKNOWN crash, no stats).
+    rule = make_rule(stats_manager, key="domain.unl", rpu=0, unit=Unit.UNKNOWN)
+    rule.unlimited = True
+    for cache in (TpuRateLimitCache(engine, clock), MemoryRateLimitCache(clock)):
+        [st] = cache.do_limit(req(Descriptor.of(("unl", ""))), [rule])
+        assert st.code == Code.OK
+        assert st.current_limit is None
+    assert stat_value(stats_manager, "domain.unl", "total_hits") == 0
+
+
+def test_mid_batch_eviction_cannot_collide(clock, stats_manager):
+    # One request with more distinct keys than free slots: pinned keys
+    # must never share a slot; uninvolved keys keep correct counts.
+    engine = CounterEngine(num_slots=3, buckets=(8,))
+    cache = TpuRateLimitCache(engine, clock)
+    rules = [
+        make_rule(stats_manager, key=f"domain.k{i}", rpu=10, unit=Unit.MINUTE)
+        for i in range(3)
+    ]
+    descs = [Descriptor.of((f"k{i}", "")) for i in range(3)]
+    sts = cache.do_limit(req(*descs, hits=8), rules)
+    assert [s.code for s in sts] == [Code.OK] * 3
+    assert [s.limit_remaining for s in sts] == [2, 2, 2]
+
+
+def test_batch_larger_than_table_raises_clear_error(clock, stats_manager):
+    engine = CounterEngine(num_slots=2, buckets=(8,))
+    cache = TpuRateLimitCache(engine, clock)
+    rules = [
+        make_rule(stats_manager, key=f"domain.x{i}", rpu=10, unit=Unit.MINUTE)
+        for i in range(3)
+    ]
+    descs = [Descriptor.of((f"x{i}", "")) for i in range(3)]
+    with pytest.raises(RuntimeError, match="slot table exhausted"):
+        cache.do_limit(req(*descs), rules)
+
+
+def test_uint32_range_hits_and_limits(engine, clock, stats_manager):
+    # Full uint32 domain: 4e9 limit, 3e9 hits -- no int32 wraparound.
+    rule = make_rule(stats_manager, key="domain.big", rpu=4_000_000_000)
+    desc = Descriptor.of(("big", ""))
+    [st] = cache_st = TpuRateLimitCache(engine, clock).do_limit(
+        req(desc, hits=3_000_000_000), [rule]
+    )
+    assert st.code == Code.OK
+    assert st.limit_remaining == 1_000_000_000
+    # Second addend pushes past the limit but stays inside uint32
+    # (counters wrap at 2^32, same as the reference's uint32 domain).
+    [st2] = TpuRateLimitCache(engine, clock).do_limit(
+        req(desc, hits=1_200_000_000), [rule]
+    )
+    assert st2.code == Code.OVER_LIMIT

@@ -1,0 +1,210 @@
+"""Device model tests (on CPU): the kernel is locked to the scalar and
+numpy implementations of the threshold machine, and the slot-table
+engine semantics (fresh reset, duplicate keys, padding) are exercised.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ratelimit_tpu.limiter.base import decide, decide_batch
+from ratelimit_tpu.models.fixed_window import (
+    CODE_OK,
+    CODE_OVER_LIMIT,
+    DeviceBatch,
+    FixedWindowModel,
+)
+from ratelimit_tpu.ops.prefix import per_slot_inclusive_prefix
+
+
+def make_batch(slots, hits=None, limits=None, fresh=None, shadow=None):
+    n = len(slots)
+    return DeviceBatch(
+        slots=jnp.asarray(slots, dtype=jnp.int32),
+        hits=jnp.asarray(hits if hits is not None else [1] * n, dtype=jnp.int32),
+        limits=jnp.asarray(limits if limits is not None else [10] * n, dtype=jnp.int32),
+        fresh=jnp.asarray(fresh if fresh is not None else [False] * n, dtype=bool),
+        shadow=jnp.asarray(shadow if shadow is not None else [False] * n, dtype=bool),
+    )
+
+
+def test_prefix_simple():
+    slots = jnp.asarray([3, 1, 3, 3, 1], dtype=jnp.int32)
+    hits = jnp.asarray([2, 5, 1, 4, 7], dtype=jnp.int32)
+    got = np.asarray(per_slot_inclusive_prefix(slots, hits))
+    # slot 3: 2, 2+1, 2+1+4; slot 1: 5, 5+7
+    assert got.tolist() == [2, 5, 3, 7, 12]
+
+
+@pytest.mark.parametrize("seed", [0, 7])
+def test_prefix_randomized(seed):
+    rng = np.random.default_rng(seed)
+    n = 257
+    slots = rng.integers(0, 17, n).astype(np.int32)
+    hits = rng.integers(0, 9, n).astype(np.int32)
+    got = np.asarray(
+        per_slot_inclusive_prefix(jnp.asarray(slots), jnp.asarray(hits))
+    )
+    for i in range(n):
+        expect = hits[(slots[:i + 1] == slots[i])[: i + 1].nonzero()[0]].sum()
+        expect = hits[: i + 1][slots[: i + 1] == slots[i]].sum()
+        assert got[i] == expect, i
+
+
+def test_step_basic_counting():
+    model = FixedWindowModel(num_slots=16)
+    counts = model.init_state()
+    # 3 sequential batches of 1 hit on slot 0, limit 2.
+    codes = []
+    for _ in range(3):
+        counts, d = model.step(counts, make_batch([0], limits=[2], fresh=[False]))
+        codes.append(int(d.codes[0]))
+    assert codes == [CODE_OK, CODE_OK, CODE_OVER_LIMIT]
+
+
+def test_step_duplicate_slots_pipeline_order():
+    # Same slot 4x in one batch with limit 2: [OK, OK, OVER, OVER]
+    # exactly like 4 pipelined INCRBYs against Redis.
+    model = FixedWindowModel(num_slots=16)
+    counts = model.init_state()
+    counts, d = model.step(
+        counts, make_batch([5, 5, 5, 5], limits=[2, 2, 2, 2])
+    )
+    assert d.codes.tolist() == [CODE_OK, CODE_OK, CODE_OVER_LIMIT, CODE_OVER_LIMIT]
+    assert d.afters.tolist() == [1, 2, 3, 4]
+    assert d.limit_remaining.tolist() == [1, 0, 0, 0]
+    assert np.asarray(counts)[5] == 4
+
+
+def test_fresh_resets_slot():
+    # A re-assigned slot (new window / evicted key) starts from zero.
+    model = FixedWindowModel(num_slots=8)
+    counts = model.init_state()
+    counts, _ = model.step(counts, make_batch([2], hits=[9]))
+    assert np.asarray(counts)[2] == 9
+    counts, d = model.step(counts, make_batch([2], hits=[1], fresh=[True]))
+    assert np.asarray(counts)[2] == 1
+    assert int(d.befores[0]) == 0
+
+
+def test_padding_is_inert():
+    # slot == num_slots entries must not touch the table or decisions.
+    model = FixedWindowModel(num_slots=4)
+    counts = model.init_state()
+    counts, d = model.step(
+        counts,
+        make_batch([1, 4, 4], hits=[1, 100, 100], limits=[10, 1, 1]),
+    )
+    assert np.asarray(counts).sum() == 1
+    assert int(d.codes[0]) == CODE_OK
+
+
+def test_shadow_in_kernel():
+    model = FixedWindowModel(num_slots=4)
+    counts = model.init_state()
+    counts, d = model.step(
+        counts, make_batch([0], hits=[5], limits=[2], shadow=[True])
+    )
+    assert int(d.codes[0]) == CODE_OK
+    assert int(d.shadow_mode[0]) == 5
+    assert int(d.over_limit[0]) == 3  # partial attribution still counted
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_kernel_matches_scalar_and_numpy(seed):
+    """Three-way lock: device kernel == numpy decide_batch == scalar
+    decide, on randomized batches with duplicate slots."""
+    rng = np.random.default_rng(seed)
+    n = 128
+    num_slots = 32
+    model = FixedWindowModel(num_slots=num_slots, near_ratio=0.8)
+    counts = model.init_state()
+
+    slots = rng.integers(0, num_slots, n).astype(np.int32)
+    hits = rng.integers(1, 6, n).astype(np.int32)
+    # One limit per slot so duplicate slots agree on the rule.
+    limits_by_slot = rng.integers(1, 30, num_slots).astype(np.int32)
+    limits = limits_by_slot[slots]
+    shadow_by_slot = rng.random(num_slots) < 0.3
+    shadow = shadow_by_slot[slots]
+
+    counts, dev = model.step(
+        counts,
+        DeviceBatch(
+            slots=jnp.asarray(slots),
+            hits=jnp.asarray(hits),
+            limits=jnp.asarray(limits),
+            fresh=jnp.zeros(n, dtype=bool),
+            shadow=jnp.asarray(shadow),
+        ),
+    )
+
+    # Emulate pipeline order on the host.
+    table = np.zeros(num_slots, dtype=np.int64)
+    befores = np.empty(n, dtype=np.int64)
+    afters = np.empty(n, dtype=np.int64)
+    for i in range(n):
+        befores[i] = table[slots[i]]
+        table[slots[i]] += hits[i]
+        afters[i] = table[slots[i]]
+    assert np.array_equal(np.asarray(counts)[: num_slots], table)
+
+    ref = decide_batch(
+        limits, befores, afters, hits, 0.8, shadow, np.zeros(n, dtype=bool)
+    )
+    assert np.array_equal(np.asarray(dev.codes), ref.codes)
+    assert np.array_equal(np.asarray(dev.limit_remaining), ref.limit_remaining)
+    assert np.array_equal(np.asarray(dev.over_limit), ref.over_limit)
+    assert np.array_equal(np.asarray(dev.near_limit), ref.near_limit)
+    assert np.array_equal(np.asarray(dev.within_limit), ref.within_limit)
+    assert np.array_equal(np.asarray(dev.shadow_mode), ref.shadow_mode)
+    assert np.array_equal(np.asarray(dev.set_local_cache), ref.set_local_cache)
+    assert np.array_equal(np.asarray(dev.befores), befores)
+    assert np.array_equal(np.asarray(dev.afters), afters)
+
+    # Scalar spot-checks on a few indices.
+    for i in rng.choice(n, 8, replace=False):
+        scalar = decide(
+            int(limits[i]), int(befores[i]), int(afters[i]), int(hits[i]), 0.8,
+            shadow_mode=bool(shadow[i]),
+        )
+        assert int(np.asarray(dev.codes)[i]) == int(scalar.code)
+
+
+def test_slot_table_assign_gc_evict():
+    from ratelimit_tpu.backends.slot_table import SlotTable
+
+    t = SlotTable(2)
+    s0, fresh0 = t.assign("a_1", now=0, expiry=10)
+    assert fresh0
+    s0b, fresh0b = t.assign("a_1", now=0, expiry=10)
+    assert s0b == s0 and not fresh0b
+    s1, _ = t.assign("b_1", now=0, expiry=20)
+    assert s1 != s0
+    # Full + nothing expired: evicts soonest-expiring ("a_1").
+    s2, fresh2 = t.assign("c_1", now=5, expiry=30)
+    assert fresh2 and s2 == s0 and t.evictions == 1
+    # "a_1" comes back as a fresh assignment.
+    s3, fresh3 = t.assign("a_1", now=5, expiry=10)
+    assert fresh3
+    # gc reclaims expired keys.
+    t.gc(now=100)
+    assert len(t) == 0
+
+
+def test_engine_bucket_padding_and_chunking():
+    from ratelimit_tpu.backends.engine import CounterEngine, HostBatch
+
+    eng = CounterEngine(num_slots=64, buckets=(4, 8))
+    n = 11  # forces chunks of 8 + 3->4
+    batch = HostBatch(
+        slots=np.arange(n, dtype=np.int32),
+        hits=np.ones(n, dtype=np.int32),
+        limits=np.full(n, 5, dtype=np.int32),
+        fresh=np.zeros(n, dtype=bool),
+        shadow=np.zeros(n, dtype=bool),
+    )
+    out = eng.step(batch)
+    assert len(out.codes) == n
+    assert (out.afters == 1).all()
