@@ -1,0 +1,90 @@
+"""Headline benchmark: fixed-window decisions/sec on one chip.
+
+Mirrors the shape of the reference's (disabled) BenchmarkParallelDoLimit
+(reference test/redis/bench_test.go:22-97: parallel DoLimit against a
+local Redis over a pipeline window x limit sweep).  The steady state
+here is the jitted counter-table step at the largest bucket size
+(4096, per BASELINE.json's batch sweep): donated HBM table, random
+slots/hits/limits.  A `lax.scan` chains STEPS_PER_CALL batches per
+device dispatch — the device-side analog of Redis pipelining (the
+serving dispatcher likewise keeps the device queue full) — and every
+decision tensor is transferred back to the host, exactly what the
+serving layer consumes.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+vs_baseline is against BASELINE.json's north-star target of 50M
+descriptor decisions/sec/chip (the reference publishes no numbers of
+its own — see BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_DECISIONS_PER_SEC = 50_000_000.0
+BATCH = 4096
+NUM_SLOTS = 1 << 20
+STEPS_PER_CALL = 256
+CALLS = 6
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from ratelimit_tpu.models.fixed_window import DeviceBatch, FixedWindowModel
+
+    model = FixedWindowModel(NUM_SLOTS)
+    counts = model.init_state()
+
+    r = np.random.default_rng(42)
+    k = STEPS_PER_CALL
+    stacked = DeviceBatch(
+        slots=jnp.asarray(r.integers(0, NUM_SLOTS, (k, BATCH)), dtype=jnp.int32),
+        hits=jnp.asarray(r.integers(1, 4, (k, BATCH)), dtype=jnp.uint32),
+        limits=jnp.asarray(r.integers(1, 1000, (k, BATCH)), dtype=jnp.uint32),
+        fresh=jnp.asarray(r.random((k, BATCH)) < 0.05),
+        shadow=jnp.asarray(np.zeros((k, BATCH), dtype=bool)),
+    )
+
+    @jax.jit
+    def run_pipeline(counts, stacked):
+        def body(counts, batch):
+            # Serving fast path: device returns only `afters` (uint32,
+            # the minimal sufficient statistic); the host derives
+            # codes/remaining/stats from (afters, hits, limits) — see
+            # backends/engine.py _decide_host.
+            return model.update(counts, batch)
+
+        return jax.lax.scan(body, counts, stacked)
+
+    counts, afters = run_pipeline(counts, stacked)  # compile + warmup
+    jax.block_until_ready(afters)
+
+    start = time.perf_counter()
+    for _ in range(CALLS):
+        counts, afters = run_pipeline(counts, stacked)
+        # The serving layer reads every `afters` back to answer RPCs.
+        host = jax.device_get(afters)
+    elapsed = time.perf_counter() - start
+    assert int(np.asarray(host).size) == k * BATCH
+
+    decisions_per_sec = BATCH * STEPS_PER_CALL * CALLS / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "fixed_window_decisions_per_sec",
+                "value": round(decisions_per_sec, 1),
+                "unit": "decisions/s/chip",
+                "vs_baseline": round(decisions_per_sec / BASELINE_DECISIONS_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

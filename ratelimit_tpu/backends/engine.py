@@ -14,7 +14,7 @@ from typing import List, Optional, Sequence
 import jax
 import numpy as np
 
-from ..models.fixed_window import DeviceBatch, DeviceDecisions, FixedWindowModel
+from ..models.fixed_window import DeviceBatch, FixedWindowModel
 
 # Pad batches up to one of these sizes so XLA compiles a handful of
 # shapes instead of one per batch length (SURVEY.md section 2 SP row:
@@ -48,6 +48,42 @@ class HostDecisions:
     set_local_cache: np.ndarray
 
 
+def _decide_host(
+    afters_padded: np.ndarray,
+    batch: "HostBatch",
+    start: int,
+    count: int,
+    near_ratio: float,
+) -> HostDecisions:
+    """Threshold state machine on host numpy, from device `afters`."""
+    from ..limiter.base import decide_batch
+
+    end = start + count
+    afters = afters_padded[:count].astype(np.int64)
+    hits = batch.hits[start:end].astype(np.int64)
+    befores = afters - hits
+    d = decide_batch(
+        limits=batch.limits[start:end],
+        befores=befores,
+        afters=afters,
+        hits=hits,
+        near_ratio=near_ratio,
+        shadow_mask=batch.shadow[start:end],
+        local_cache_mask=np.zeros(count, dtype=bool),
+    )
+    return HostDecisions(
+        codes=d.codes,
+        limit_remaining=d.limit_remaining,
+        befores=befores,
+        afters=afters,
+        over_limit=d.over_limit,
+        near_limit=d.near_limit,
+        within_limit=d.within_limit,
+        shadow_mode=d.shadow_mode,
+        set_local_cache=d.set_local_cache.astype(bool),
+    )
+
+
 class CounterEngine:
     def __init__(
         self,
@@ -55,11 +91,19 @@ class CounterEngine:
         near_ratio: float = 0.8,
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         device: Optional[jax.Device] = None,
+        model=None,
     ):
+        """`model` defaults to a single-chip FixedWindowModel; pass any
+        object with the same surface (init_state/step_counters/
+        num_slots/near_ratio) — e.g. parallel.ShardedFixedWindowModel —
+        to run the same host orchestration over a different device
+        layout."""
         from .slot_table import SlotTable
 
-        self.model = FixedWindowModel(num_slots, near_ratio)
-        self.slot_table = SlotTable(num_slots)
+        self.model = model if model is not None else FixedWindowModel(
+            num_slots, near_ratio
+        )
+        self.slot_table = SlotTable(self.model.num_slots)
         self.buckets = tuple(sorted(buckets))
         self.max_batch = self.buckets[-1]
         self._device = device
@@ -123,18 +167,20 @@ class CounterEngine:
             fresh=jax.numpy.asarray(fr),
             shadow=jax.numpy.asarray(sh),
         )
-        self._counts, decisions = self.model.step(self._counts, device_batch)
-        host: DeviceDecisions = jax.device_get(decisions)
-        return HostDecisions(
-            codes=host.codes[:count],
-            limit_remaining=host.limit_remaining[:count],
-            befores=host.befores[:count],
-            afters=host.afters[:count],
-            over_limit=host.over_limit[:count],
-            near_limit=host.near_limit[:count],
-            within_limit=host.within_limit[:count],
-            shadow_mode=host.shadow_mode[:count],
-            set_local_cache=host.set_local_cache[:count].astype(bool),
+        # Serving fast path: the device returns only `afters` (the
+        # minimal sufficient statistic, 4B/lane); the threshold state
+        # machine reruns vectorized on host from (afters, hits, limits)
+        # — bit-identical to the on-device DeviceDecisions path, which
+        # tests/test_counter_model.py locks against both.
+        self._counts, afters_dev = self.model.step_counters(
+            self._counts, device_batch
+        )
+        return _decide_host(
+            jax.device_get(afters_dev),
+            batch,
+            start,
+            count,
+            self.model.near_ratio,
         )
 
     def reset(self) -> None:

@@ -1,0 +1,13 @@
+"""Multi-chip parallelism: sharded counter banks over a jax Mesh.
+
+The reference scales horizontally with stateless replicas sharing Redis
+(cluster key-slot sharding, reference src/redis/driver_impl.go:108-126).
+The TPU-native analog shards the slot space itself across devices: each
+chip owns a contiguous bank of counter slots in its HBM, batches are
+replicated, and each chip answers for the slots it owns; decisions are
+combined with one psum over ICI (SURVEY.md section 2, TP row).
+"""
+
+from .sharded import ShardedCounterEngine, ShardedFixedWindowModel, make_mesh
+
+__all__ = ["ShardedCounterEngine", "ShardedFixedWindowModel", "make_mesh"]

@@ -1,0 +1,180 @@
+"""Sharded fixed-window counter model: slot space split across a Mesh.
+
+Design (TPU-first, not a translation of the reference's Redis cluster):
+
+- The counter table is one logical uint32[num_banks * slots_per_bank]
+  array laid out as (num_banks, slots_per_bank) and sharded over mesh
+  axis ``banks`` with ``NamedSharding(P("banks", None))`` — each chip
+  holds exactly its bank in HBM.
+- A batch is replicated to every chip.  Under ``shard_map`` each chip
+  masks the batch to the slots it owns, runs the same branch-free
+  fixed-window decision body as the single-chip model
+  (models/fixed_window.py), and zeroes every lane it does not own.
+- One ``psum`` over ``banks`` (rides ICI) recombines the per-lane
+  decisions: each lane is owned by exactly one chip, so the sum is a
+  select.  No gather/scatter collectives, no host round trips.
+
+This is the Redis-cluster key-slot analog (reference
+src/redis/driver_impl.go:108-126: radix cluster routes each key by hash
+slot) built the SPMD way: instead of routing requests to the owning
+node over TCP, every chip sees every request and ownership is a mask.
+The slot id already encodes the bank (slot // slots_per_bank), so the
+host-side SlotTable needs no changes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..backends.engine import CounterEngine
+from ..models.fixed_window import DeviceBatch, DeviceDecisions, decision_block
+from ..ops.prefix import per_slot_inclusive_prefix
+
+
+def make_mesh(
+    n_devices: Optional[int] = None, axis: str = "banks"
+) -> Mesh:
+    """1-D device mesh over the first `n_devices` local devices."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))
+
+
+class ShardedFixedWindowModel:
+    """Fixed-window decisions over a bank-sharded counter table.
+
+    ``num_slots`` is the GLOBAL slot count; it is rounded up to a
+    multiple of the mesh size so every bank is equal-sized (XLA needs
+    even sharding).  Slot ids from the host SlotTable index the global
+    space; bank ownership is ``slot // slots_per_bank``.
+    """
+
+    def __init__(self, num_slots: int, mesh: Mesh, near_ratio: float = 0.8):
+        self.mesh = mesh
+        self.axis = mesh.axis_names[0]
+        self.num_banks = mesh.devices.size
+        self.slots_per_bank = -(-int(num_slots) // self.num_banks)
+        self.num_slots = self.slots_per_bank * self.num_banks
+        self.near_ratio = float(near_ratio)
+
+        counts_spec = NamedSharding(mesh, P(self.axis, None))
+        repl = NamedSharding(mesh, P())
+        shard_map = jax.shard_map
+
+        def build(body):
+            return jax.jit(
+                shard_map(
+                    body,
+                    mesh=mesh,
+                    in_specs=(P(self.axis, None), P()),
+                    out_specs=(P(self.axis, None), P()),
+                ),
+                in_shardings=(counts_spec, repl),
+                out_shardings=(counts_spec, repl),
+                donate_argnums=0,
+            )
+
+        self._step = build(self._bank_step)
+        self._step_counters = build(self._bank_update)
+        self._counts_sharding = counts_spec
+        self._batch_sharding = repl
+
+    def init_state(self) -> jax.Array:
+        """Fresh sharded counter table: (num_banks, slots_per_bank)."""
+        return jax.device_put(
+            jnp.zeros((self.num_banks, self.slots_per_bank), dtype=jnp.uint32),
+            self._counts_sharding,
+        )
+
+    def step(
+        self, counts: jax.Array, batch: DeviceBatch
+    ) -> Tuple[jax.Array, DeviceDecisions]:
+        return self._step(counts, batch)
+
+    def step_counters(
+        self, counts: jax.Array, batch: DeviceBatch
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Counter update only; returns (counts, afters) — the serving
+        fast path (see models/fixed_window.py step_counters)."""
+        return self._step_counters(counts, batch)
+
+    # -- per-bank SPMD bodies (run on every chip under shard_map) -------
+
+    def _bank_core(self, counts, batch: DeviceBatch):
+        """Shared per-bank counter update; returns (counts, afters,
+        owned) with `afters` valid only on owned lanes (0 elsewhere)."""
+        # counts: uint32[1, slots_per_bank] — this chip's bank.
+        spb = self.slots_per_bank
+        bank = jax.lax.axis_index(self.axis)
+        base = (bank * spb).astype(jnp.int32)
+
+        local = batch.slots - base
+        in_table = (batch.slots >= 0) & (batch.slots < self.num_slots)
+        owns_slot = in_table & (batch.slots >= base) & (local < spb)
+        # Out-of-table lanes (padding) read a virtual zero counter and
+        # scatter nowhere; bank 0 claims them so their decisions match
+        # the single-chip model lane-for-lane.
+        owned = owns_slot | (~in_table & (bank == 0))
+        lslots = jnp.where(owns_slot, local, spb)  # spb = inert (drop/fill)
+
+        row = counts[0]
+        fresh_idx = jnp.where(batch.fresh & owns_slot, lslots, spb)
+        row = row.at[fresh_idx].set(jnp.uint32(0), mode="drop")
+
+        table_before = row.at[lslots].get(mode="fill", fill_value=0)
+
+        # Pipeline-order duplicates: global computation, replicated on
+        # every chip (slots are global ids so segments are identical).
+        incl = per_slot_inclusive_prefix(batch.slots, batch.hits)
+        afters = jnp.where(owned, table_before + incl, jnp.uint32(0))
+
+        masked_hits = jnp.where(owns_slot, batch.hits, jnp.uint32(0))
+        row = row.at[lslots].add(masked_hits, mode="drop")
+        return row[None, :], afters, owned
+
+    def _bank_update(self, counts, batch: DeviceBatch):
+        counts, afters, _ = self._bank_core(counts, batch)
+        return counts, jax.lax.psum(afters, self.axis)
+
+    def _bank_step(self, counts, batch: DeviceBatch):
+        counts, afters, owned = self._bank_core(counts, batch)
+        full = decision_block(
+            afters, batch.hits, batch.limits, batch.shadow, self.near_ratio
+        )
+        # Zero every lane this bank does not own, then psum: each lane
+        # is owned by exactly one bank, so the sum is a select.
+        partial = jax.tree_util.tree_map(
+            lambda x: jnp.where(owned, x, jnp.zeros_like(x)).astype(
+                jnp.int32 if x.dtype == jnp.bool_ else x.dtype
+            ),
+            full,
+        )
+        decisions = jax.tree_util.tree_map(
+            lambda x: jax.lax.psum(x, self.axis), partial
+        )
+        return counts, decisions
+
+
+
+class ShardedCounterEngine(CounterEngine):
+    """CounterEngine over a bank-sharded model: identical host
+    orchestration (slot table, bucketing, padding, host-side decide),
+    counter table sharded across the mesh."""
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        num_slots: int = 1 << 20,
+        near_ratio: float = 0.8,
+        buckets: Sequence[int] = (8, 32, 128, 512, 1024, 2048, 4096),
+    ):
+        super().__init__(
+            buckets=buckets,
+            model=ShardedFixedWindowModel(num_slots, mesh, near_ratio),
+        )

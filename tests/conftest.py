@@ -15,6 +15,13 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# A sitecustomize may have imported jax and pinned another platform
+# before this conftest runs; the config update wins as long as no
+# backend has been initialized yet.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 from ratelimit_tpu.stats.manager import Manager  # noqa: E402
