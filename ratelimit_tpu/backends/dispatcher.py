@@ -1,0 +1,228 @@
+"""Micro-batching dispatcher: the implicit-pipelining analog.
+
+The reference gets cross-request batching for free from radix's
+implicit pipelining (one Redis round trip aggregates commands from
+concurrent goroutines within a flush window — reference
+src/settings/settings.go:71-77, src/redis/driver_impl.go:94-99).  Here
+the expensive round trip is a device launch, so the dispatcher plays
+radix's role: concurrent RPC threads submit work items; a single
+dispatcher thread accumulates them up to ``batch_window`` /
+``batch_limit`` lanes, assembles ONE padded device batch, runs the
+engine step, and scatters the decisions back to the waiting threads.
+
+The dispatcher thread is also the only toucher of the engine's
+SlotTable, so key->slot assignment needs no locks (SURVEY.md section 2
+in-process concurrency row: single dispatcher owning the device queue).
+
+``flush()`` drains everything submitted before it — the deterministic
+test hook the reference implements as Flush()/AutoFlushForIntegration-
+Tests for its async memcache writes (src/memcached/cache_impl.go:54,
+176-178).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .engine import HostBatch, HostDecisions
+
+
+@dataclass(frozen=True)
+class Lane:
+    """One descriptor bound for the counter engine."""
+
+    key: str
+    expiry: int
+    limit: int
+    shadow: bool
+    hits: int
+
+
+@dataclass
+class WorkItem:
+    """One request's engine-bound lanes + completion callback."""
+
+    now: int
+    lanes: Sequence[Lane]
+    apply: Callable[[HostDecisions], None]
+    event: threading.Event = field(default_factory=threading.Event)
+    error: Optional[BaseException] = None
+
+    def wait(self, timeout: float = 30.0) -> None:
+        # The timeout is a liveness backstop: if the dispatcher died
+        # between submit and processing (e.g. shutdown race), fail the
+        # RPC instead of hanging the transport thread forever.
+        if not self.event.wait(timeout):
+            raise TimeoutError(
+                f"batch dispatcher did not answer within {timeout}s"
+            )
+        if self.error is not None:
+            raise self.error
+
+
+class _FlushToken:
+    __slots__ = ("event",)
+
+    def __init__(self):
+        self.event = threading.Event()
+
+
+_STOP = object()
+
+
+def _slice(d: HostDecisions, lo: int, hi: int) -> HostDecisions:
+    return HostDecisions(
+        **{f: getattr(d, f)[lo:hi] for f in HostDecisions.__dataclass_fields__}
+    )
+
+
+def run_items(engine, items: List[WorkItem]) -> None:
+    """Assemble one engine batch from `items`, step, scatter results.
+
+    Must be called from the single thread that owns `engine`'s
+    SlotTable (the dispatcher thread, or the caller in inline mode).
+    """
+    total = sum(len(it.lanes) for it in items)
+    if total == 0:
+        for it in items:
+            it.event.set()
+        return
+    slots = np.empty(total, dtype=np.int32)
+    hits = np.empty(total, dtype=np.uint32)
+    limits = np.empty(total, dtype=np.uint32)
+    fresh = np.empty(total, dtype=bool)
+    shadow = np.empty(total, dtype=bool)
+
+    try:
+        table = engine.slot_table
+        table.begin_batch()
+        try:
+            j = 0
+            for it in items:
+                for lane in it.lanes:
+                    slots[j], fresh[j] = engine.assign_slot(
+                        lane.key, it.now, lane.expiry
+                    )
+                    hits[j] = min(lane.hits, 0xFFFFFFFF)
+                    limits[j] = lane.limit
+                    shadow[j] = lane.shadow
+                    j += 1
+        finally:
+            table.end_batch()
+
+        decisions = engine.step(HostBatch(slots, hits, limits, fresh, shadow))
+    except BaseException as e:
+        for it in items:
+            it.error = e
+            it.event.set()
+        return
+
+    off = 0
+    for it in items:
+        n = len(it.lanes)
+        try:
+            it.apply(_slice(decisions, off, off + n))
+        except BaseException as e:
+            it.error = e
+        off += n
+        it.event.set()
+
+
+class BatchDispatcher:
+    """Single background thread batching WorkItems for one engine."""
+
+    def __init__(
+        self,
+        engine,
+        batch_window_us: int = 200,
+        batch_limit: int = 4096,
+        name: str = "tpu-dispatcher",
+    ):
+        self.engine = engine
+        self.window_s = batch_window_us / 1e6
+        self.batch_limit = int(batch_limit)
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(target=self._loop, name=name, daemon=True)
+        self._thread.start()
+
+    def submit(self, item: WorkItem) -> None:
+        self._q.put(item)
+
+    def flush(self) -> None:
+        """Block until everything submitted before this call has been
+        processed (FIFO queue: the token trails all earlier items)."""
+        token = _FlushToken()
+        self._q.put(token)
+        token.event.wait()
+
+    def stop(self) -> None:
+        self._q.put(_STOP)
+        self._thread.join(timeout=10)
+
+    # -- internals -------------------------------------------------------
+
+    def _collect(self) -> Tuple[List[WorkItem], List[_FlushToken], bool]:
+        """Block for the first item, then accumulate until the window
+        closes, the lane budget fills, or a flush/stop arrives."""
+        batch: List[WorkItem] = []
+        tokens: List[_FlushToken] = []
+        stopping = False
+
+        obj = self._q.get()
+        deadline = time.monotonic() + self.window_s
+        lanes = 0
+        while True:
+            if obj is _STOP:
+                stopping = True
+                break
+            if isinstance(obj, _FlushToken):
+                tokens.append(obj)
+                break  # flush short-circuits the window
+            batch.append(obj)
+            lanes += len(obj.lanes)
+            if lanes >= self.batch_limit:
+                break
+            timeout = deadline - time.monotonic()
+            if timeout <= 0:
+                break
+            try:
+                obj = self._q.get(timeout=timeout)
+            except queue.Empty:
+                break
+        return batch, tokens, stopping
+
+    def _loop(self) -> None:
+        while True:
+            batch, tokens, stopping = self._collect()
+            if batch:
+                run_items(self.engine, batch)
+            for t in tokens:
+                t.event.set()
+            if stopping:
+                self._drain()
+                return
+
+    def _drain(self) -> None:
+        """Complete everything still queued at stop time so no waiter
+        hangs (items racing stop() land behind the _STOP sentinel)."""
+        leftovers: List[WorkItem] = []
+        while True:
+            try:
+                obj = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if isinstance(obj, WorkItem):
+                leftovers.append(obj)
+            elif isinstance(obj, _FlushToken):
+                if leftovers:
+                    run_items(self.engine, leftovers)
+                    leftovers = []
+                obj.event.set()
+        if leftovers:
+            run_items(self.engine, leftovers)

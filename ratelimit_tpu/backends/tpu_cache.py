@@ -13,16 +13,22 @@ INCRBY+EXPIRE round trip replaced by one batched device step:
    fixed_cache_impl.go:57-67's ``continue``);
 4. per-second limits route to a dedicated engine bank when configured
    (dual-Redis analog, fixed_cache_impl.go:77-87);
-5. one device step per bank; decisions and stat attribution come back
-   index-aligned;
+5. engine-bound lanes run either inline (batch_window_us=0) or through
+   the micro-batching dispatcher (one device launch shared by
+   concurrent RPCs — the radix implicit-pipelining analog,
+   settings.go:71-77);
 6. statuses assembled with duration-until-reset; first over-limit
    transitions populate the host cache with TTL = full window
    (base_limiter.go:103-115).
+
+Backend failures surface as service.CacheError (the RedisError panic
+analog, driver_impl.go:60-64) so the service boundary can count them.
 """
 
 from __future__ import annotations
 
 import random
+import threading
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -38,7 +44,8 @@ from ..utils.time import (
     unit_to_divider,
     window_start,
 )
-from .engine import CounterEngine, HostBatch
+from .dispatcher import BatchDispatcher, Lane, WorkItem, run_items
+from .engine import CounterEngine, HostDecisions
 
 _CAT_NONE = 0  # no matching rule: OK, no stats
 _CAT_ENGINE = 1  # goes to the counter engine
@@ -56,6 +63,8 @@ class TpuRateLimitCache:
         expiration_jitter_max_seconds: int = 0,
         cache_key_prefix: str = "",
         jitter_rand: Optional[random.Random] = None,
+        batch_window_us: int = 0,
+        batch_limit: int = 4096,
     ):
         self.engine = engine
         self.per_second_engine = per_second_engine
@@ -64,6 +73,30 @@ class TpuRateLimitCache:
         self.key_generator = CacheKeyGenerator(cache_key_prefix)
         self.expiration_jitter_max_seconds = int(expiration_jitter_max_seconds)
         self.jitter_rand = jitter_rand or random.Random()
+        # The reference wraps its jitter rand in a mutex because
+        # rand.Rand isn't goroutine-safe (utils/time.go:28-48); same.
+        self._jitter_lock = threading.Lock()
+
+        # Inline mode (batch_window_us=0) runs the engine step on the
+        # RPC caller thread; a per-engine lock serializes access to the
+        # SlotTable and the donated counts buffer, which the dispatcher
+        # thread otherwise owns exclusively.
+        self._inline_locks = {id(engine): threading.Lock()}
+        if per_second_engine is not None:
+            self._inline_locks[id(per_second_engine)] = threading.Lock()
+
+        self._dispatchers: dict = {}
+        if batch_window_us > 0:
+            self._dispatchers[id(engine)] = BatchDispatcher(
+                engine, batch_window_us, batch_limit, name="tpu-dispatcher"
+            )
+            if per_second_engine is not None:
+                self._dispatchers[id(per_second_engine)] = BatchDispatcher(
+                    per_second_engine,
+                    batch_window_us,
+                    batch_limit,
+                    name="tpu-dispatcher-persecond",
+                )
 
     # -- RateLimitCache seam --------------------------------------------
 
@@ -105,13 +138,37 @@ class TpuRateLimitCache:
 
         statuses: List[Optional[DescriptorStatus]] = [None] * n
 
+        items: List[tuple] = []  # (engine, WorkItem)
         for engine, rows in (
             (self.engine, engine_rows),
             (self.per_second_engine, per_second_rows),
         ):
             if not rows:
                 continue
-            self._run_bank(engine, rows, keys, limits, hits_addend, now, statuses)
+            items.append(
+                (engine, self._make_item(rows, keys, limits, hits_addend, now, statuses))
+            )
+
+        # Submit all banks first, then wait: the two banks' device
+        # steps overlap (the reference likewise pipelines both Redis
+        # clients before the first PipeDo, fixed_cache_impl.go:77-95).
+        inline: List[tuple] = []
+        for engine, item in items:
+            d = self._dispatchers.get(id(engine))
+            if d is None:
+                inline.append((engine, item))
+            else:
+                d.submit(item)
+        for engine, item in inline:
+            with self._inline_locks[id(engine)]:
+                run_items(engine, [item])
+        for _, item in items:
+            try:
+                item.wait()
+            except Exception as e:
+                from ..service import CacheError
+
+                raise CacheError(f"counter engine failure: {e}") from e
 
         # Non-engine categories.
         reset_cache: dict = {}
@@ -145,48 +202,71 @@ class TpuRateLimitCache:
         return statuses  # type: ignore[return-value]
 
     def flush(self) -> None:
-        """Synchronous backend: nothing queued (fixed_cache_impl.go:116)."""
+        """Drain the dispatcher queues (deterministic test hook; the
+        reference's memcached Flush analog, cache_impl.go:176-178)."""
+        for d in list(self._dispatchers.values()):
+            d.flush()
+
+    def close(self) -> None:
+        dispatchers, self._dispatchers = list(self._dispatchers.values()), {}
+        for d in dispatchers:
+            d.stop()
 
     # -- internals -------------------------------------------------------
 
-    def _run_bank(
+    def _make_item(
         self,
-        engine: CounterEngine,
         rows: List[int],
         keys,
         limits,
         hits_addend: int,
         now: int,
         statuses: List[Optional[DescriptorStatus]],
+    ) -> WorkItem:
+        jitters = None
+        if self.expiration_jitter_max_seconds > 0:
+            # Spread slot reclamation like the reference spreads Redis
+            # TTLs (fixed_cache_impl.go:71-74); one lock acquisition
+            # per request, not per lane.
+            with self._jitter_lock:
+                jitters = [
+                    self.jitter_rand.randrange(self.expiration_jitter_max_seconds)
+                    for _ in rows
+                ]
+        lanes = []
+        for j, i in enumerate(rows):
+            rule = limits[i]
+            unit = rule.limit.unit
+            expiry = window_start(now, unit) + unit_to_divider(unit)
+            if jitters is not None:
+                expiry += jitters[j]
+            lanes.append(
+                Lane(
+                    key=keys[i].key,
+                    expiry=expiry,
+                    limit=rule.limit.requests_per_unit,
+                    shadow=rule.shadow_mode,
+                    hits=hits_addend,
+                )
+            )
+
+        def apply(decisions: HostDecisions) -> None:
+            self._apply_decisions(
+                rows, keys, limits, hits_addend, now, decisions, statuses
+            )
+
+        return WorkItem(now=now, lanes=lanes, apply=apply)
+
+    def _apply_decisions(
+        self,
+        rows: List[int],
+        keys,
+        limits,
+        hits_addend: int,
+        now: int,
+        decisions: HostDecisions,
+        statuses: List[Optional[DescriptorStatus]],
     ) -> None:
-        m = len(rows)
-        slots = np.empty(m, dtype=np.int32)
-        fresh = np.empty(m, dtype=bool)
-        hits = np.full(m, min(hits_addend, 0xFFFFFFFF), dtype=np.uint32)
-        lims = np.empty(m, dtype=np.uint32)
-        shadow = np.empty(m, dtype=bool)
-
-        table = engine.slot_table
-        table.begin_batch()
-        try:
-            for j, i in enumerate(rows):
-                rule = limits[i]
-                unit = rule.limit.unit
-                expiry = window_start(now, unit) + unit_to_divider(unit)
-                if self.expiration_jitter_max_seconds > 0:
-                    # Spread slot reclamation like the reference spreads
-                    # Redis TTLs (fixed_cache_impl.go:71-74).
-                    expiry += self.jitter_rand.randrange(
-                        self.expiration_jitter_max_seconds
-                    )
-                slots[j], fresh[j] = engine.assign_slot(keys[i].key, now, expiry)
-                lims[j] = rule.limit.requests_per_unit
-                shadow[j] = rule.shadow_mode
-        finally:
-            table.end_batch()
-
-        decisions = engine.step(HostBatch(slots, hits, lims, fresh, shadow))
-
         reset_cache: dict = {}
         for j, i in enumerate(rows):
             rule = limits[i]

@@ -1,0 +1,66 @@
+"""gRPC smoke client (reference src/client_cmd/main.go:47-86).
+
+    python -m ratelimit_tpu.cli.client \
+        --dial_string localhost:8081 --domain mongo_cps \
+        --descriptors database=users,database=default --hits-addend 1
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import grpc
+
+from ..server import pb  # noqa: F401
+
+from envoy.service.ratelimit.v3 import rls_pb2  # noqa: E402
+
+
+def parse_descriptors(spec: str) -> "rls_pb2.RateLimitRequest":
+    """`k=v,k2=v2` -> one descriptor with those entries (client_cmd's
+    -descriptors flag format)."""
+    request = rls_pb2.RateLimitRequest()
+    descriptor = request.descriptors.add()
+    for pair in spec.split(","):
+        if not pair:
+            continue
+        key, _, value = pair.partition("=")
+        entry = descriptor.entries.add()
+        entry.key, entry.value = key, value
+    return request
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="ratelimit gRPC client")
+    p.add_argument("--dial_string", default="localhost:8081")
+    p.add_argument("--domain", required=True)
+    p.add_argument(
+        "--descriptors",
+        required=True,
+        help="descriptor list: k=v,k2=v2 (one descriptor)",
+    )
+    p.add_argument("--hits-addend", type=int, default=0)
+    args = p.parse_args(argv)
+
+    request = parse_descriptors(args.descriptors)
+    request.domain = args.domain
+    request.hits_addend = args.hits_addend
+
+    with grpc.insecure_channel(args.dial_string) as channel:
+        method = channel.unary_unary(
+            "/envoy.service.ratelimit.v3.RateLimitService/ShouldRateLimit",
+            request_serializer=rls_pb2.RateLimitRequest.SerializeToString,
+            response_deserializer=rls_pb2.RateLimitResponse.FromString,
+        )
+        try:
+            response = method(request, timeout=10)
+        except grpc.RpcError as e:
+            print(f"error: {e.code().name}: {e.details()}", file=sys.stderr)
+            return 1
+    print(response)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

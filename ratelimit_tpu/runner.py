@@ -1,0 +1,248 @@
+"""Process bootstrap: Settings -> backend -> service -> listeners.
+
+The reference's runner wires stats, logging, the freecache local
+cache, the gRPC/HTTP/debug servers, the backend cache (selected by
+BACKEND_TYPE) and the service with its runtime config loader
+(reference src/service_cmd/runner/runner.go:39-143,
+src/server/server_impl.go:176-313).  Same shape here, with the TPU
+counter engine as the default backend.
+
+Run directly:  python -m ratelimit_tpu.runner
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+from typing import Optional
+
+from .config.runtime import RuntimeLoader
+from .service import RateLimitService
+from .settings import Settings, new_settings
+from .stats.manager import Manager
+from .stats.statsd import StatsdExporter
+from .utils.time import RealTimeSource
+
+logger = logging.getLogger("ratelimit")
+
+_LOG_LEVELS = {
+    "TRACE": logging.DEBUG,
+    "DEBUG": logging.DEBUG,
+    "INFO": logging.INFO,
+    "WARN": logging.WARNING,
+    "WARNING": logging.WARNING,
+    "ERROR": logging.ERROR,
+}
+
+
+def create_limiter(s: Settings, stats_manager: Manager, local_cache, time_source):
+    """BackendType switch (reference runner.go:50-74)."""
+    backend = s.backend_type.lower()
+    if backend == "memory":
+        from .backends.memory_cache import MemoryRateLimitCache
+
+        return MemoryRateLimitCache(
+            time_source=time_source,
+            local_cache=local_cache,
+            near_ratio=s.near_limit_ratio,
+            cache_key_prefix=s.cache_key_prefix,
+            expiration_jitter_max_seconds=s.expiration_jitter_max_seconds,
+        )
+    if backend in ("tpu", "tpu-sharded"):
+        from .backends.engine import CounterEngine
+        from .backends.tpu_cache import TpuRateLimitCache
+
+        if backend == "tpu-sharded":
+            import jax
+
+            from .parallel import ShardedCounterEngine, make_mesh
+
+            mesh = make_mesh()
+            engine = ShardedCounterEngine(
+                mesh,
+                num_slots=s.tpu_num_slots,
+                near_ratio=s.near_limit_ratio,
+                buckets=tuple(s.tpu_batch_buckets),
+            )
+            per_second_engine = (
+                ShardedCounterEngine(
+                    make_mesh(),
+                    num_slots=s.tpu_per_second_num_slots,
+                    near_ratio=s.near_limit_ratio,
+                    buckets=tuple(s.tpu_batch_buckets),
+                )
+                if s.tpu_per_second
+                else None
+            )
+        else:
+            engine = CounterEngine(
+                num_slots=s.tpu_num_slots,
+                near_ratio=s.near_limit_ratio,
+                buckets=tuple(s.tpu_batch_buckets),
+            )
+            per_second_engine = (
+                CounterEngine(
+                    num_slots=s.tpu_per_second_num_slots,
+                    near_ratio=s.near_limit_ratio,
+                    buckets=tuple(s.tpu_batch_buckets),
+                )
+                if s.tpu_per_second
+                else None
+            )
+        return TpuRateLimitCache(
+            engine,
+            time_source=time_source,
+            per_second_engine=per_second_engine,
+            local_cache=local_cache,
+            expiration_jitter_max_seconds=s.expiration_jitter_max_seconds,
+            cache_key_prefix=s.cache_key_prefix,
+            batch_window_us=s.tpu_batch_window_us,
+            batch_limit=s.tpu_batch_limit,
+        )
+    raise ValueError(f"Invalid setting for BackendType: {s.backend_type}")
+
+
+class Runner:
+    def __init__(self, settings: Optional[Settings] = None):
+        self.settings = settings or new_settings()
+        self.stats_manager = Manager(extra_tags=self.settings.extra_tags)
+        self._stopped = threading.Event()
+        self.cache = None
+        self.service = None
+        self.runtime = None
+        self.grpc_server = None
+        self.http_server = None
+        self.debug_server = None
+        self.statsd = None
+        self.health = None
+
+    # -- lifecycle (runner.go:76-143) -----------------------------------
+
+    def start(self) -> None:
+        """Wire everything and start all listeners (non-blocking)."""
+        s = self.settings
+        logging.basicConfig(
+            level=_LOG_LEVELS.get(s.log_level.upper(), logging.WARNING),
+            format=(
+                '{"@timestamp":"%(asctime)s","level":"%(levelname)s",'
+                '"@message":"%(message)s"}'
+                if s.log_format == "json"
+                else "%(asctime)s %(levelname)s %(name)s %(message)s"
+            ),
+        )
+
+        from .server.health import HealthChecker
+        from .server.grpc_server import create_grpc_server
+        from .server.http_server import (
+            HttpServer,
+            add_debug_routes,
+            add_healthcheck,
+            add_json_handler,
+        )
+
+        local_cache = None
+        if s.local_cache_size_in_bytes > 0:
+            from .limiter.local_cache import LocalCache
+
+            local_cache = LocalCache(s.local_cache_size_in_bytes)
+            local_cache.register_stats(self.stats_manager.store)
+
+        time_source = RealTimeSource()
+        self.cache = create_limiter(s, self.stats_manager, local_cache, time_source)
+
+        self.runtime = RuntimeLoader(
+            s.runtime_path,
+            s.runtime_subdirectory,
+            ignore_dot_files=s.runtime_ignore_dot_files,
+        )
+        self.service = RateLimitService(
+            self.runtime,
+            self.cache,
+            self.stats_manager,
+            runtime_watch_root=s.runtime_watch_root,
+            clock=time_source,
+            global_shadow_mode=s.global_shadow_mode,
+            headers_enabled=s.rate_limit_response_headers_enabled,
+            header_limit=s.header_ratelimit_limit,
+            header_remaining=s.header_ratelimit_remaining,
+            header_reset=s.header_ratelimit_reset,
+        )
+        self.runtime.start()
+
+        self.health = HealthChecker()
+
+        self.grpc_server = create_grpc_server(
+            self.service,
+            self.health,
+            store=self.stats_manager.store,
+            host=s.grpc_host,
+            port=s.grpc_port,
+            max_connection_age_s=s.grpc_max_connection_age,
+            max_connection_age_grace_s=s.grpc_max_connection_age_grace,
+        )
+        self.grpc_server.start()
+
+        self.http_server = HttpServer(s.host, s.port, name="api")
+        add_json_handler(self.http_server, self.service)
+        add_healthcheck(self.http_server, self.health)
+        self.http_server.start()
+
+        self.debug_server = HttpServer(s.debug_host, s.debug_port, name="debug")
+        add_debug_routes(self.debug_server, self.stats_manager.store, self.service)
+        add_healthcheck(self.debug_server, self.health)
+        self.debug_server.start()
+
+        if s.use_statsd:
+            self.statsd = StatsdExporter(
+                self.stats_manager.store, s.statsd_host, s.statsd_port
+            )
+            self.statsd.start()
+
+        logger.warning(
+            "ratelimit serving: http=%s grpc=%s debug=%s backend=%s",
+            self.http_server.bound_port,
+            self.grpc_server.bound_port,
+            self.debug_server.bound_port,
+            s.backend_type,
+        )
+
+    def run(self) -> None:
+        """start() + install signal handlers + block until stopped
+        (reference Run blocks in http.Serve, server_impl.go:139-152;
+        SIGTERM flips health to NOT_SERVING first, health.go:28-35)."""
+        self.start()
+
+        def handle(signum, frame):
+            logger.warning("received signal %s, shutting down", signum)
+            if self.health is not None:
+                self.health.fail()
+            self.stop()
+
+        for sig in (signal.SIGINT, signal.SIGTERM, signal.SIGHUP):
+            signal.signal(sig, handle)
+        self._stopped.wait()
+
+    def stop(self) -> None:
+        """Graceful stop (reference Stop, runner.go:136-143 +
+        handleGracefulShutdown, server_impl.go:302-313)."""
+        if self.grpc_server is not None:
+            self.grpc_server.stop(grace=5).wait(timeout=10)
+        for srv in (self.http_server, self.debug_server):
+            if srv is not None:
+                srv.stop()
+        if self.runtime is not None:
+            self.runtime.stop()
+        if self.statsd is not None:
+            self.statsd.stop()
+        if self.cache is not None and hasattr(self.cache, "close"):
+            self.cache.close()
+        self._stopped.set()
+
+
+def main() -> None:
+    Runner().run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,149 @@
+"""gRPC transport: RateLimitService + grpc.health.v1 on one server.
+
+The reference registers the generated pb service on grpc-go with a
+metrics interceptor and keepalive MaxConnectionAge options
+(reference src/service_cmd/runner/runner.go:100-131,
+src/server/server_impl.go:183-188).  grpcio has no protoc-plugin stubs
+here, so the services are registered via generic method handlers with
+the generated messages' serializers — wire-identical to stub-generated
+registration (method path
+``/envoy.service.ratelimit.v3.RateLimitService/ShouldRateLimit``).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from . import pb  # noqa: F401  (sys.path setup)
+
+from envoy.service.ratelimit.v3 import rls_pb2  # noqa: E402
+from grpchealth.v1 import health_pb2  # noqa: E402
+
+from ..service import CacheError, ServiceError  # noqa: E402
+from ..stats.manager import StatsStore  # noqa: E402
+from .codec import request_from_pb, response_to_pb  # noqa: E402
+from .health import HealthChecker  # noqa: E402
+
+logger = logging.getLogger("ratelimit.grpc")
+
+RATELIMIT_SERVICE = "envoy.service.ratelimit.v3.RateLimitService"
+HEALTH_SERVICE = "grpc.health.v1.Health"
+
+
+class ServerReporter:
+    """Per-method total_requests counter + response_time ms timer
+    (reference src/metrics/metrics.go:30-46)."""
+
+    def __init__(self, store: StatsStore, scope: str = "ratelimit_server"):
+        self.store = store
+        self.scope = scope
+
+    def observe(self, method: str, elapsed_s: float) -> None:
+        base = f"{self.scope}.{method}"
+        self.store.counter(base + ".total_requests").inc()
+        self.store.timer(base + ".response_time").add_duration_ms(elapsed_s * 1e3)
+
+
+def _ratelimit_handler(service, reporter: Optional[ServerReporter]):
+    def should_rate_limit(request_pb, context):
+        start = time.perf_counter()
+        try:
+            request = request_from_pb(request_pb)
+            try:
+                response = service.should_rate_limit(request)
+            except (ServiceError, CacheError) as e:
+                # grpc-go turns a plain returned error into UNKNOWN;
+                # mirror that mapping (service/ratelimit.go:239-265).
+                context.abort(grpc.StatusCode.UNKNOWN, str(e))
+            return response_to_pb(response)
+        finally:
+            if reporter is not None:
+                reporter.observe("ShouldRateLimit", time.perf_counter() - start)
+
+    return grpc.method_handlers_generic_handler(
+        RATELIMIT_SERVICE,
+        {
+            "ShouldRateLimit": grpc.unary_unary_rpc_method_handler(
+                should_rate_limit,
+                request_deserializer=rls_pb2.RateLimitRequest.FromString,
+                response_serializer=rls_pb2.RateLimitResponse.SerializeToString,
+            )
+        },
+    )
+
+
+def _health_handler(health: HealthChecker):
+    def status():
+        return (
+            health_pb2.HealthCheckResponse.SERVING
+            if health.healthy
+            else health_pb2.HealthCheckResponse.NOT_SERVING
+        )
+
+    def check(request, context):
+        return health_pb2.HealthCheckResponse(status=status())
+
+    def watch(request, context):
+        # Minimal Watch: emit the current status, then follow changes
+        # by polling; terminates with the connection.
+        last = None
+        while context.is_active():
+            cur = status()
+            if cur != last:
+                yield health_pb2.HealthCheckResponse(status=cur)
+                last = cur
+            time.sleep(1.0)
+
+    return grpc.method_handlers_generic_handler(
+        HEALTH_SERVICE,
+        {
+            "Check": grpc.unary_unary_rpc_method_handler(
+                check,
+                request_deserializer=health_pb2.HealthCheckRequest.FromString,
+                response_serializer=health_pb2.HealthCheckResponse.SerializeToString,
+            ),
+            "Watch": grpc.unary_stream_rpc_method_handler(
+                watch,
+                request_deserializer=health_pb2.HealthCheckRequest.FromString,
+                response_serializer=health_pb2.HealthCheckResponse.SerializeToString,
+            ),
+        },
+    )
+
+
+def create_grpc_server(
+    service,
+    health: HealthChecker,
+    store: Optional[StatsStore] = None,
+    host: str = "0.0.0.0",
+    port: int = 8081,
+    max_connection_age_s: float = 24 * 3600.0,
+    max_connection_age_grace_s: float = 3600.0,
+    max_workers: int = 32,
+) -> grpc.Server:
+    """Build (not start) the server; port 0 picks a free port.  The
+    bound port is stored on the returned server as ``bound_port``."""
+    options = [
+        # Forces client re-resolution for elastic scaling
+        # (settings.go:23-27, README "GRPC Keepalive").
+        ("grpc.max_connection_age_ms", int(max_connection_age_s * 1000)),
+        ("grpc.max_connection_age_grace_ms", int(max_connection_age_grace_s * 1000)),
+        ("grpc.so_reuseport", 1),
+    ]
+    reporter = ServerReporter(store) if store is not None else None
+    server = grpc.server(
+        futures.ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="grpc-rpc"
+        ),
+        options=options,
+    )
+    server.add_generic_rpc_handlers(
+        (_ratelimit_handler(service, reporter), _health_handler(health))
+    )
+    server.bound_port = server.add_insecure_port(f"{host}:{port}")
+    return server

@@ -1,0 +1,27 @@
+"""Health state shared by the HTTP /healthcheck endpoint and the gRPC
+grpc.health.v1 service (reference src/server/health.go: atomic ok flag,
+SIGTERM flips to NOT_SERVING before shutdown, Fail/Ok used by backend
+connection health)."""
+
+from __future__ import annotations
+
+import threading
+
+
+class HealthChecker:
+    def __init__(self, name: str = "ratelimit"):
+        self.name = name
+        self._ok = threading.Event()
+        self._ok.set()
+
+    @property
+    def healthy(self) -> bool:
+        return self._ok.is_set()
+
+    def fail(self) -> None:
+        """Mark unhealthy (health.go:49-52)."""
+        self._ok.clear()
+
+    def ok(self) -> None:
+        """Mark healthy (health.go:54-57)."""
+        self._ok.set()

@@ -1,0 +1,206 @@
+"""HTTP transports: the port-8080 API server and the port-6070 debug
+server (reference src/server/server_impl.go: 3 listeners — HTTP, gRPC,
+debug — :119-153, :238-269).
+
+API server routes (server_impl.go:110-117, 227-233):
+- POST /json        JSON <-> pb bridge into ShouldRateLimit;
+                    OK->200, OVER_LIMIT->429, UNKNOWN->500 (:102-106),
+                    unparseable body -> 400 (:76-82).
+- GET  /healthcheck 200 "OK" / 500 per HealthChecker.
+
+Debug server routes (server_impl.go:238-269, runner.go:117-124):
+- GET /stats            flat counters/gauges/timers dump
+- GET /rlconfig         current config dump
+- GET /debug/pprof/     pointer to py-spy (Go pprof has no stdlib
+                        Python analog; profiling is external)
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from google.protobuf import json_format
+
+from . import pb  # noqa: F401
+
+from envoy.service.ratelimit.v3 import rls_pb2  # noqa: E402
+
+from ..service import CacheError, ServiceError  # noqa: E402
+from .codec import request_from_pb, response_to_pb  # noqa: E402
+from .health import HealthChecker  # noqa: E402
+
+logger = logging.getLogger("ratelimit.http")
+
+
+class _Router:
+    def __init__(self):
+        self.routes: Dict[tuple, Callable] = {}
+
+    def add(self, method: str, path: str, fn: Callable) -> None:
+        self.routes[(method, path)] = fn
+
+    def dispatch(self, method: str, path: str):
+        return self.routes.get((method, path))
+
+
+def _make_handler(router: _Router):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # route to logging, not stderr
+            logger.debug("%s " + fmt, self.address_string(), *args)
+
+        def _reply(self, code: int, body: bytes, content_type: str = "text/plain"):
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _run(self, method: str):
+            fn = router.dispatch(method, self.path.split("?", 1)[0])
+            if fn is None:
+                self._reply(404, b"not found\n")
+                return
+            try:
+                fn(self)
+            except BrokenPipeError:
+                pass
+            except Exception as e:  # handler bug: 500, keep serving
+                logger.exception("handler error on %s", self.path)
+                try:
+                    self._reply(500, f"{e}\n".encode())
+                except Exception:
+                    pass
+
+        def do_GET(self):
+            self._run("GET")
+
+        def do_POST(self):
+            self._run("POST")
+
+    return Handler
+
+
+class HttpServer:
+    """ThreadingHTTPServer wrapper with route registration and
+    start/stop lifecycle."""
+
+    def __init__(self, host: str, port: int, name: str = "http"):
+        self.router = _Router()
+        self._server = ThreadingHTTPServer(
+            (host, port), _make_handler(self.router)
+        )
+        self._server.daemon_threads = True
+        self.bound_port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+        self._name = name
+
+    def add_route(self, method: str, path: str, fn) -> None:
+        self.router.add(method, path, fn)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name=f"{self._name}-listener",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def add_json_handler(server: HttpServer, service) -> None:
+    """POST /json bridge (reference NewJsonHandler,
+    server_impl.go:71-109)."""
+
+    def handle(h) -> None:
+        length = int(h.headers.get("Content-Length") or 0)
+        body = h.rfile.read(length) if length else b""
+        request_pb = rls_pb2.RateLimitRequest()
+        try:
+            json_format.Parse(body.decode("utf-8"), request_pb)
+        except Exception as e:
+            h._reply(400, f"error parsing request body: {e}\n".encode())
+            return
+        try:
+            response = service.should_rate_limit(request_from_pb(request_pb))
+        except (ServiceError, CacheError) as e:
+            h._reply(500, f"{e}\n".encode())
+            return
+        response_pb = response_to_pb(response)
+        out = json_format.MessageToJson(response_pb).encode("utf-8")
+        code = rls_pb2.RateLimitResponse.Code.Name(response_pb.overall_code)
+        if code == "OK":
+            status = 200
+        elif code == "OVER_LIMIT":
+            status = 429
+        else:
+            status = 500
+        h._reply(status, out, content_type="application/json")
+
+    server.add_route("POST", "/json", handle)
+
+
+def add_healthcheck(server: HttpServer, health: HealthChecker) -> None:
+    def handle(h) -> None:
+        if health.healthy:
+            h._reply(200, b"OK")
+        else:
+            h._reply(500, b"NOT_HEALTHY")
+
+    server.add_route("GET", "/healthcheck", handle)
+
+
+def add_debug_routes(server: HttpServer, store, service=None) -> None:
+    """/stats and /rlconfig (server_impl.go:254-261, runner.go:117-124)."""
+
+    def stats(h) -> None:
+        lines = []
+        for name, value in sorted(store.snapshot().items()):
+            lines.append(f"{name}: {value}")
+        for name, summary in sorted(store.timers().items()):
+            lines.append(
+                f"{name}: count={summary['count']} "
+                f"mean_ms={summary['mean_ms']:.3f} max_ms={summary['max_ms']:.3f}"
+            )
+        h._reply(200, ("\n".join(lines) + "\n").encode())
+
+    def stats_json(h) -> None:
+        h._reply(
+            200,
+            json.dumps(
+                {"stats": store.snapshot(), "timers": store.timers()}
+            ).encode(),
+            content_type="application/json",
+        )
+
+    server.add_route("GET", "/stats", stats)
+    server.add_route("GET", "/stats.json", stats_json)
+
+    if service is not None:
+
+        def rlconfig(h) -> None:
+            config = service.get_current_config()
+            dump = config.dump() if config is not None else ""
+            h._reply(200, dump.encode())
+
+        server.add_route("GET", "/rlconfig", rlconfig)
+
+    def pprof(h) -> None:
+        h._reply(
+            200,
+            b"python process: use py-spy or jax.profiler for profiling; "
+            b"see /stats for counters\n",
+        )
+
+    server.add_route("GET", "/debug/pprof/", pprof)

@@ -1,0 +1,3 @@
+from .ratelimit import CacheError, RateLimitService, ServiceError
+
+__all__ = ["CacheError", "RateLimitService", "ServiceError"]

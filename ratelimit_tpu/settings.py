@@ -1,0 +1,191 @@
+"""Process configuration from environment variables.
+
+Mirrors the reference's envconfig-driven Settings struct
+(reference src/settings/settings.go:11-119): same env var names and
+defaults for everything that carries over, plus the TPU-engine knobs
+that replace the Redis/Memcache connection settings (the reference's
+Redis knobs configure a TCP client; ours configure the on-chip counter
+engine and its micro-batching dispatcher).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+def _env_str(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError as e:
+        raise SettingsError(f"{name}: invalid integer {raw!r}") from e
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError as e:
+        raise SettingsError(f"{name}: invalid float {raw!r}") from e
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    low = raw.strip().lower()
+    if low in ("1", "true", "t", "yes", "y", "on"):
+        return True
+    if low in ("0", "false", "f", "no", "n", "off"):
+        return False
+    raise SettingsError(f"{name}: invalid boolean {raw!r}")
+
+
+def _env_tags(name: str) -> Dict[str, str]:
+    """EXTRA_TAGS-style map: "k1:v1,k2:v2" (envconfig map syntax)."""
+    raw = os.environ.get(name, "")
+    out: Dict[str, str] = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" not in part:
+            raise SettingsError(f"{name}: invalid map entry {part!r}")
+        k, v = part.split(":", 1)
+        out[k.strip()] = v.strip()
+    return out
+
+
+def _env_int_list(name: str, default: List[int]) -> List[int]:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return list(default)
+    try:
+        return [int(p) for p in raw.split(",") if p.strip()]
+    except ValueError as e:
+        raise SettingsError(f"{name}: invalid int list {raw!r}") from e
+
+
+class SettingsError(Exception):
+    """Invalid environment configuration (envconfig.Process panics in
+    the reference, settings.go:110-119)."""
+
+
+@dataclass
+class Settings:
+    # Server listen addresses (settings.go:15-20).
+    host: str = "0.0.0.0"
+    port: int = 8080
+    grpc_host: str = "0.0.0.0"
+    grpc_port: int = 8081
+    debug_host: str = "0.0.0.0"
+    debug_port: int = 6070
+
+    # gRPC keepalive (settings.go:25-27); seconds.
+    grpc_max_connection_age: float = 24 * 3600.0
+    grpc_max_connection_age_grace: float = 3600.0
+
+    # Logging (settings.go:30-31).
+    log_level: str = "WARN"
+    log_format: str = "text"
+
+    # Stats sink (settings.go:34-37).
+    use_statsd: bool = True
+    statsd_host: str = "localhost"
+    statsd_port: int = 8125
+    extra_tags: Dict[str, str] = field(default_factory=dict)
+
+    # Rate limit config runtime (settings.go:40-43).
+    runtime_path: str = "/srv/runtime_data/current"
+    runtime_subdirectory: str = ""
+    runtime_ignore_dot_files: bool = False
+    runtime_watch_root: bool = True
+
+    # Cache-wide knobs (settings.go:46-50).
+    expiration_jitter_max_seconds: int = 300
+    local_cache_size_in_bytes: int = 0
+    near_limit_ratio: float = 0.8
+    cache_key_prefix: str = ""
+    backend_type: str = "tpu"  # reference default "redis"; ours: tpu|memory
+
+    # Custom response headers (settings.go:53-59).
+    rate_limit_response_headers_enabled: bool = False
+    header_ratelimit_limit: str = "RateLimit-Limit"
+    header_ratelimit_remaining: str = "RateLimit-Remaining"
+    header_ratelimit_reset: str = "RateLimit-Reset"
+
+    # TPU counter-engine knobs (replace the Redis connection settings,
+    # settings.go:62-92; the dual per-second engine mirrors
+    # REDIS_PERSECOND's second instance).
+    tpu_num_slots: int = 1 << 20
+    tpu_per_second: bool = False
+    tpu_per_second_num_slots: int = 1 << 20
+    tpu_batch_buckets: List[int] = field(
+        default_factory=lambda: [8, 32, 128, 512, 1024, 2048, 4096]
+    )
+    # Micro-batch dispatcher (the implicit-pipelining analog,
+    # settings.go:71-77; radix defaults to a 150us window).
+    tpu_batch_window_us: int = 200
+    tpu_batch_limit: int = 4096
+
+    # Global shadow mode (settings.go:105).
+    global_shadow_mode: bool = False
+
+
+def new_settings() -> Settings:
+    """Read Settings from the environment (settings.go:110-119)."""
+    s = Settings(
+        host=_env_str("HOST", "0.0.0.0"),
+        port=_env_int("PORT", 8080),
+        grpc_host=_env_str("GRPC_HOST", "0.0.0.0"),
+        grpc_port=_env_int("GRPC_PORT", 8081),
+        debug_host=_env_str("DEBUG_HOST", "0.0.0.0"),
+        debug_port=_env_int("DEBUG_PORT", 6070),
+        grpc_max_connection_age=_env_float("GRPC_MAX_CONNECTION_AGE", 24 * 3600.0),
+        grpc_max_connection_age_grace=_env_float(
+            "GRPC_MAX_CONNECTION_AGE_GRACE", 3600.0
+        ),
+        log_level=_env_str("LOG_LEVEL", "WARN"),
+        log_format=_env_str("LOG_FORMAT", "text"),
+        use_statsd=_env_bool("USE_STATSD", True),
+        statsd_host=_env_str("STATSD_HOST", "localhost"),
+        statsd_port=_env_int("STATSD_PORT", 8125),
+        extra_tags=_env_tags("EXTRA_TAGS"),
+        runtime_path=_env_str("RUNTIME_ROOT", "/srv/runtime_data/current"),
+        runtime_subdirectory=_env_str("RUNTIME_SUBDIRECTORY", ""),
+        runtime_ignore_dot_files=_env_bool("RUNTIME_IGNOREDOTFILES", False),
+        runtime_watch_root=_env_bool("RUNTIME_WATCH_ROOT", True),
+        expiration_jitter_max_seconds=_env_int("EXPIRATION_JITTER_MAX_SECONDS", 300),
+        local_cache_size_in_bytes=_env_int("LOCAL_CACHE_SIZE_IN_BYTES", 0),
+        near_limit_ratio=_env_float("NEAR_LIMIT_RATIO", 0.8),
+        cache_key_prefix=_env_str("CACHE_KEY_PREFIX", ""),
+        backend_type=_env_str("BACKEND_TYPE", "tpu"),
+        rate_limit_response_headers_enabled=_env_bool(
+            "LIMIT_RESPONSE_HEADERS_ENABLED", False
+        ),
+        header_ratelimit_limit=_env_str("LIMIT_LIMIT_HEADER", "RateLimit-Limit"),
+        header_ratelimit_remaining=_env_str(
+            "LIMIT_REMAINING_HEADER", "RateLimit-Remaining"
+        ),
+        header_ratelimit_reset=_env_str("LIMIT_RESET_HEADER", "RateLimit-Reset"),
+        tpu_num_slots=_env_int("TPU_NUM_SLOTS", 1 << 20),
+        tpu_per_second=_env_bool("TPU_PERSECOND", False),
+        tpu_per_second_num_slots=_env_int("TPU_PERSECOND_NUM_SLOTS", 1 << 20),
+        tpu_batch_buckets=_env_int_list(
+            "TPU_BATCH_BUCKETS", [8, 32, 128, 512, 1024, 2048, 4096]
+        ),
+        tpu_batch_window_us=_env_int("TPU_BATCH_WINDOW_US", 200),
+        tpu_batch_limit=_env_int("TPU_BATCH_LIMIT", 4096),
+        global_shadow_mode=_env_bool("SHADOW_MODE", False),
+    )
+    return s

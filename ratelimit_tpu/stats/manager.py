@@ -72,6 +72,51 @@ class Gauge:
             return self._value
 
 
+class Timer:
+    """Millisecond timer: count / total / max (the gostats timer the
+    gRPC interceptor feeds, reference src/metrics/metrics.go:41-44)."""
+
+    __slots__ = ("name", "_count", "_total_ms", "_max_ms", "_samples", "_lock")
+
+    # Per-flush sample retention cap: statsd timers are per-observation
+    # ("|ms" lines); beyond this the flush interval reports a sampled
+    # subset, which statsd aggregation tolerates.
+    MAX_SAMPLES = 512
+
+    def __init__(self, name: str):
+        self.name = name
+        self._count = 0
+        self._total_ms = 0.0
+        self._max_ms = 0.0
+        self._samples: list = []
+        self._lock = threading.Lock()
+
+    def add_duration_ms(self, ms: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._total_ms += ms
+            if ms > self._max_ms:
+                self._max_ms = ms
+            if len(self._samples) < self.MAX_SAMPLES:
+                self._samples.append(ms)
+
+    def drain_samples(self) -> list:
+        """Samples observed since the last drain (statsd export)."""
+        with self._lock:
+            samples, self._samples = self._samples, []
+            return samples
+
+    def summary(self) -> Dict[str, float]:
+        with self._lock:
+            mean = self._total_ms / self._count if self._count else 0.0
+            return {
+                "count": self._count,
+                "total_ms": self._total_ms,
+                "mean_ms": mean,
+                "max_ms": self._max_ms,
+            }
+
+
 class StatsStore:
     """Flat name -> Counter/Gauge registry; idempotent creation."""
 
@@ -79,7 +124,20 @@ class StatsStore:
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._gauge_fns: Dict[str, "callable"] = {}
+        self._timers: Dict[str, Timer] = {}
         self._lock = threading.Lock()
+
+    def timer(self, name: str) -> Timer:
+        with self._lock:
+            t = self._timers.get(name)
+            if t is None:
+                t = self._timers[name] = Timer(name)
+            return t
+
+    def timers(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            items = list(self._timers.items())
+        return {name: t.summary() for name, t in items}
 
     def counter(self, name: str) -> Counter:
         with self._lock:

@@ -1,0 +1,17 @@
+#!/bin/sh
+# Regenerate the committed protobuf message classes under
+# ratelimit_tpu/server/pb/ from protos/.  Run from the repo root.
+# Only message classes are generated (protoc --python_out); the gRPC
+# service is registered via grpcio generic handlers (no grpc_tools
+# plugin needed) -- see ratelimit_tpu/server/grpc_server.py.
+set -e
+protoc -Iprotos \
+  --python_out=ratelimit_tpu/server/pb \
+  protos/envoy/type/v3/ratelimit_unit.proto \
+  protos/envoy/config/core/v3/base.proto \
+  protos/envoy/extensions/common/ratelimit/v3/ratelimit.proto \
+  protos/envoy/service/ratelimit/v3/rls.proto \
+  protos/grpchealth/v1/health.proto
+# Make every generated package importable.
+find ratelimit_tpu/server/pb -type d -exec touch {}/__init__.py \;
+echo regenerated.

@@ -248,7 +248,9 @@ def test_batch_larger_than_table_raises_clear_error(clock, stats_manager):
         for i in range(3)
     ]
     descs = [Descriptor.of((f"x{i}", "")) for i in range(3)]
-    with pytest.raises(RuntimeError, match="slot table exhausted"):
+    from ratelimit_tpu.service import CacheError
+
+    with pytest.raises(CacheError, match="slot table exhausted"):
         cache.do_limit(req(*descs), rules)
 
 

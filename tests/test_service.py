@@ -1,0 +1,250 @@
+"""Service-layer tests (model: reference test/service/ratelimit_test.go).
+
+Everything below the service is faked: a dict-backed runtime and a
+programmable cache, per the reference's gomock pattern (suite at
+ratelimit_test.go:58-104).
+"""
+
+import pytest
+
+from ratelimit_tpu.api import (
+    MAX_UINT32,
+    Code,
+    Descriptor,
+    DescriptorStatus,
+    RateLimit,
+    RateLimitRequest,
+    Unit,
+)
+from ratelimit_tpu.service import CacheError, RateLimitService, ServiceError
+from ratelimit_tpu.stats.manager import Manager
+
+
+BASIC_YAML = """
+domain: test-domain
+descriptors:
+  - key: key1
+    value: value1
+    rate_limit:
+      unit: minute
+      requests_per_unit: 10
+  - key: unlim
+    rate_limit:
+      unlimited: true
+"""
+
+
+class FakeRuntime:
+    def __init__(self, files):
+        self.files = dict(files)
+        self.callbacks = []
+
+    def snapshot(self):
+        data = dict(self.files)
+
+        class Snap:
+            def keys(self):
+                return sorted(data)
+
+            def get(self, key):
+                return data.get(key, "")
+
+        return Snap()
+
+    def add_update_callback(self, fn):
+        self.callbacks.append(fn)
+
+    def fire(self):
+        for fn in self.callbacks:
+            fn()
+
+
+class FakeCache:
+    """Programmable RateLimitCache: returns queued statuses or a
+    default OK per descriptor."""
+
+    def __init__(self):
+        self.next_statuses = None
+        self.raise_error = None
+        self.calls = []
+
+    def do_limit(self, request, limits):
+        self.calls.append((request, limits))
+        if self.raise_error is not None:
+            raise self.raise_error
+        if self.next_statuses is not None:
+            out, self.next_statuses = self.next_statuses, None
+            return out
+        return [DescriptorStatus(code=Code.OK) for _ in request.descriptors]
+
+    def flush(self):
+        pass
+
+
+@pytest.fixture
+def runtime():
+    return FakeRuntime({"config.basic": BASIC_YAML})
+
+
+@pytest.fixture
+def cache():
+    return FakeCache()
+
+
+def make_service(runtime, cache, mgr=None, **kw):
+    return RateLimitService(runtime, cache, mgr or Manager(), **kw)
+
+
+def test_initial_load_and_reload(runtime, cache):
+    mgr = Manager()
+    svc = make_service(runtime, cache, mgr)
+    assert svc.get_current_config() is not None
+    assert mgr.store.counters()["ratelimit.service.config_load_success"] == 1
+
+    # Bad reload keeps old config (ratelimit.go:50-60).
+    old = svc.get_current_config()
+    runtime.files["config.basic"] = "domain: [broken"
+    runtime.fire()
+    assert mgr.store.counters()["ratelimit.service.config_load_error"] == 1
+    assert svc.get_current_config() is old
+
+    # Good reload swaps.
+    runtime.files["config.basic"] = BASIC_YAML.replace("test-domain", "other")
+    runtime.fire()
+    assert mgr.store.counters()["ratelimit.service.config_load_success"] == 2
+    assert svc.get_current_config() is not old
+
+
+def test_watch_root_filters_non_config_keys(cache):
+    runtime = FakeRuntime(
+        {"config.basic": BASIC_YAML, "other.junk": "not yaml: ["}
+    )
+    svc = make_service(runtime, cache, runtime_watch_root=True)
+    assert svc.get_current_config().get_limit(
+        "test-domain", Descriptor.of(("key1", "value1"))
+    ) is not None
+
+
+def test_empty_domain_and_descriptors(runtime, cache):
+    mgr = Manager()
+    svc = make_service(runtime, cache, mgr)
+    with pytest.raises(ServiceError):
+        svc.should_rate_limit(RateLimitRequest("", [Descriptor.of(("k", "v"))]))
+    with pytest.raises(ServiceError):
+        svc.should_rate_limit(RateLimitRequest("test-domain", []))
+    key = "ratelimit.service.call.should_rate_limit.service_error"
+    assert mgr.store.counters()[key] == 2
+
+
+def test_cache_error_counted(runtime, cache):
+    mgr = Manager()
+    svc = make_service(runtime, cache, mgr)
+    cache.raise_error = CacheError("engine down")
+    with pytest.raises(CacheError):
+        svc.should_rate_limit(
+            RateLimitRequest("test-domain", [Descriptor.of(("key1", "value1"))])
+        )
+    key = "ratelimit.service.call.should_rate_limit.redis_error"
+    assert mgr.store.counters()[key] == 1
+
+
+def test_overall_code_is_or_of_statuses(runtime, cache):
+    svc = make_service(runtime, cache)
+    limit = RateLimit(10, Unit.MINUTE)
+    cache.next_statuses = [
+        DescriptorStatus(code=Code.OK, current_limit=limit, limit_remaining=4),
+        DescriptorStatus(code=Code.OVER_LIMIT, current_limit=limit),
+    ]
+    resp = svc.should_rate_limit(
+        RateLimitRequest(
+            "test-domain",
+            [Descriptor.of(("key1", "value1")), Descriptor.of(("key1", "value2"))],
+        )
+    )
+    assert resp.overall_code == Code.OVER_LIMIT
+    assert [s.code for s in resp.statuses] == [Code.OK, Code.OVER_LIMIT]
+
+
+def test_unlimited_descriptor(runtime, cache):
+    svc = make_service(runtime, cache)
+    resp = svc.should_rate_limit(
+        RateLimitRequest("test-domain", [Descriptor.of(("unlim", "x"))])
+    )
+    assert resp.overall_code == Code.OK
+    assert resp.statuses[0].limit_remaining == MAX_UINT32
+    # The cache must have been called with a nil rule (ratelimit.go:140-144).
+    _, limits = cache.calls[-1]
+    assert limits == [None]
+
+
+def test_global_shadow_mode(runtime, cache):
+    mgr = Manager()
+    svc = make_service(runtime, cache, mgr, global_shadow_mode=True)
+    limit = RateLimit(10, Unit.MINUTE)
+    cache.next_statuses = [
+        DescriptorStatus(code=Code.OVER_LIMIT, current_limit=limit)
+    ]
+    resp = svc.should_rate_limit(
+        RateLimitRequest("test-domain", [Descriptor.of(("key1", "value1"))])
+    )
+    # Overall flips to OK but the per-descriptor status stays
+    # (ratelimit.go:204-207).
+    assert resp.overall_code == Code.OK
+    assert resp.statuses[0].code == Code.OVER_LIMIT
+    assert mgr.store.counters()["ratelimit.service.global_shadow_mode"] == 1
+
+
+def test_custom_headers_track_min_remaining(runtime, cache, clock):
+    svc = make_service(
+        runtime, cache, clock=clock, headers_enabled=True
+    )
+    limit = RateLimit(10, Unit.MINUTE)
+    cache.next_statuses = [
+        DescriptorStatus(code=Code.OK, current_limit=limit, limit_remaining=7),
+        DescriptorStatus(code=Code.OK, current_limit=limit, limit_remaining=3),
+    ]
+    resp = svc.should_rate_limit(
+        RateLimitRequest(
+            "test-domain",
+            [Descriptor.of(("key1", "value1")), Descriptor.of(("key1", "value2"))],
+        )
+    )
+    headers = {h.key: h.value for h in resp.response_headers_to_add}
+    # clock pinned at 1234; minute window resets in 60 - 1234%60 = 26s.
+    assert headers == {
+        "RateLimit-Limit": "10",
+        "RateLimit-Remaining": "3",
+        "RateLimit-Reset": "26",
+    }
+
+
+def test_custom_headers_over_limit_wins(runtime, cache, clock):
+    svc = make_service(runtime, cache, clock=clock, headers_enabled=True)
+    limit = RateLimit(10, Unit.MINUTE)
+    cache.next_statuses = [
+        DescriptorStatus(code=Code.OK, current_limit=limit, limit_remaining=2),
+        DescriptorStatus(
+            code=Code.OVER_LIMIT, current_limit=limit, limit_remaining=0
+        ),
+    ]
+    resp = svc.should_rate_limit(
+        RateLimitRequest(
+            "test-domain",
+            [Descriptor.of(("key1", "value1")), Descriptor.of(("key1", "value2"))],
+        )
+    )
+    headers = {h.key: h.value for h in resp.response_headers_to_add}
+    assert headers["RateLimit-Remaining"] == "0"
+    assert resp.overall_code == Code.OVER_LIMIT
+
+
+def test_no_config_loaded_is_service_error(cache):
+    runtime = FakeRuntime({})  # no config files at all -> empty config
+    mgr = Manager()
+    svc = make_service(runtime, cache, mgr)
+    # Empty-but-valid runtime loads an empty config: requests simply
+    # match nothing (reference: loader with zero files yields a config).
+    resp = svc.should_rate_limit(
+        RateLimitRequest("test-domain", [Descriptor.of(("key1", "value1"))])
+    )
+    assert resp.overall_code == Code.OK

@@ -1,0 +1,109 @@
+"""Settings env parsing, runtime watcher, statsd export."""
+
+import socket
+import time
+
+import pytest
+
+from ratelimit_tpu.config.runtime import RuntimeLoader
+from ratelimit_tpu.settings import SettingsError, new_settings
+from ratelimit_tpu.stats.manager import StatsStore
+from ratelimit_tpu.stats.statsd import StatsdExporter
+
+
+def test_settings_defaults(monkeypatch):
+    for var in ("PORT", "BACKEND_TYPE", "SHADOW_MODE"):
+        monkeypatch.delenv(var, raising=False)
+    s = new_settings()
+    assert s.port == 8080
+    assert s.grpc_port == 8081
+    assert s.debug_port == 6070
+    assert s.backend_type == "tpu"
+    assert s.near_limit_ratio == pytest.approx(0.8)
+    assert s.expiration_jitter_max_seconds == 300
+    assert s.global_shadow_mode is False
+
+
+def test_settings_env_overrides(monkeypatch):
+    monkeypatch.setenv("PORT", "9999")
+    monkeypatch.setenv("SHADOW_MODE", "true")
+    monkeypatch.setenv("EXTRA_TAGS", "env:prod,region:us")
+    monkeypatch.setenv("TPU_BATCH_BUCKETS", "16,64,256")
+    s = new_settings()
+    assert s.port == 9999
+    assert s.global_shadow_mode is True
+    assert s.extra_tags == {"env": "prod", "region": "us"}
+    assert s.tpu_batch_buckets == [16, 64, 256]
+
+
+def test_settings_invalid_values(monkeypatch):
+    monkeypatch.setenv("PORT", "not-a-port")
+    with pytest.raises(SettingsError):
+        new_settings()
+    monkeypatch.setenv("PORT", "8080")
+    monkeypatch.setenv("USE_STATSD", "maybe")
+    with pytest.raises(SettingsError):
+        new_settings()
+
+
+def test_runtime_loader_snapshot_and_watch(tmp_path):
+    config = tmp_path / "ratelimit" / "config"
+    config.mkdir(parents=True)
+    (config / "a.yaml").write_text("domain: a\n")
+    (tmp_path / "ratelimit" / ".hidden.yaml").write_text("x")
+
+    loader = RuntimeLoader(
+        str(tmp_path), "ratelimit", ignore_dot_files=True, poll_interval=0.05
+    )
+    snap = loader.snapshot()
+    assert snap.keys() == ["config.a"]
+    assert snap.get("config.a") == "domain: a\n"
+
+    fired = []
+    loader.add_update_callback(lambda: fired.append(1))
+
+    # force_update is the deterministic hook.
+    (config / "b.yaml").write_text("domain: b\n")
+    assert loader.force_update() is True
+    assert fired == [1]
+    assert loader.snapshot().keys() == ["config.a", "config.b"]
+    assert loader.force_update() is False  # no change, no callback
+    assert fired == [1]
+
+    # The polling thread picks changes up too.
+    loader.start()
+    try:
+        (config / "c.yaml").write_text("domain: c\n")
+        deadline = time.time() + 5
+        while time.time() < deadline and len(fired) < 2:
+            time.sleep(0.02)
+        assert len(fired) >= 2
+    finally:
+        loader.stop()
+
+
+def test_statsd_exporter_flush():
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    recv.settimeout(5)
+    port = recv.getsockname()[1]
+
+    store = StatsStore()
+    store.counter("ratelimit.service.x").add(3)
+    store.gauge("ratelimit.g").set(7)
+    store.timer("ratelimit_server.ShouldRateLimit.response_time").add_duration_ms(1.5)
+
+    ex = StatsdExporter(store, "127.0.0.1", port, interval_s=60)
+    ex.flush()
+    payload = recv.recv(65536).decode()
+    lines = set(payload.split("\n"))
+    assert "ratelimit.service.x:3|c" in lines
+    assert "ratelimit.g:7|g" in lines
+    assert "ratelimit_server.ShouldRateLimit.response_time:1.500|ms" in lines
+
+    # Counters flush as deltas: unchanged counter emits nothing.
+    ex.flush()
+    payload = recv.recv(65536).decode()
+    assert "ratelimit.service.x" not in payload
+    assert "ratelimit.g:7|g" in payload
+    recv.close()
