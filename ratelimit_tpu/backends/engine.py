@@ -168,13 +168,27 @@ class CounterEngine:
             shadow=jax.numpy.asarray(sh),
         )
         # Serving fast path: the device returns only `afters` (the
-        # minimal sufficient statistic, 4B/lane); the threshold state
-        # machine reruns vectorized on host from (afters, hits, limits)
-        # — bit-identical to the on-device DeviceDecisions path, which
-        # tests/test_counter_model.py locks against both.
-        self._counts, afters_dev = self.model.step_counters(
-            self._counts, device_batch
-        )
+        # minimal sufficient statistic); the threshold state machine
+        # reruns vectorized on host from (afters, hits, limits) —
+        # bit-identical to the on-device DeviceDecisions path, which
+        # tests/test_counter_model.py locks against both.  When every
+        # lane's limit+hits fits in uint8/uint16, the saturated narrow
+        # readback shrinks the device->host transfer 4x/2x (see
+        # FixedWindowModel.step_counters_compact for the exactness
+        # argument).
+        cap = int(hi[:count].max(initial=0)) + int(li[:count].max(initial=1))
+        if cap <= 0xFF:
+            self._counts, afters_dev = self.model.step_counters_compact(
+                self._counts, "uint8", device_batch
+            )
+        elif cap <= 0xFFFF:
+            self._counts, afters_dev = self.model.step_counters_compact(
+                self._counts, "uint16", device_batch
+            )
+        else:
+            self._counts, afters_dev = self.model.step_counters(
+                self._counts, device_batch
+            )
         return _decide_host(
             jax.device_get(afters_dev),
             batch,

@@ -65,6 +65,7 @@ class TpuRateLimitCache:
         jitter_rand: Optional[random.Random] = None,
         batch_window_us: int = 0,
         batch_limit: int = 4096,
+        dispatch_timeout_s: float = 120.0,
     ):
         self.engine = engine
         self.per_second_engine = per_second_engine
@@ -73,6 +74,11 @@ class TpuRateLimitCache:
         self.key_generator = CacheKeyGenerator(cache_key_prefix)
         self.expiration_jitter_max_seconds = int(expiration_jitter_max_seconds)
         self.jitter_rand = jitter_rand or random.Random()
+        # Liveness backstop for dispatcher waits; generous because the
+        # first batch through a new (bucket, dtype) shape pays XLA
+        # compilation (~seconds to tens of seconds on big meshes) —
+        # see warmup() to pre-pay that before serving.
+        self.dispatch_timeout_s = float(dispatch_timeout_s)
         # The reference wraps its jitter rand in a mutex because
         # rand.Rand isn't goroutine-safe (utils/time.go:28-48); same.
         self._jitter_lock = threading.Lock()
@@ -164,7 +170,7 @@ class TpuRateLimitCache:
                 run_items(engine, [item])
         for _, item in items:
             try:
-                item.wait()
+                item.wait(self.dispatch_timeout_s)
             except Exception as e:
                 from ..service import CacheError
 
@@ -211,6 +217,31 @@ class TpuRateLimitCache:
         dispatchers, self._dispatchers = list(self._dispatchers.values()), {}
         for d in dispatchers:
             d.stop()
+
+    def warmup(self) -> None:
+        """Pre-compile every (bucket, readback-dtype) kernel shape so
+        the first real RPC never pays XLA compilation.  Uses inert
+        batches (all lanes point one past the slot table), so counter
+        state and the slot table are untouched.  Call before serving
+        starts — it steps the engines directly."""
+        import numpy as np
+
+        for engine in (self.engine, self.per_second_engine):
+            if engine is None:
+                continue
+            from .engine import HostBatch
+
+            for bucket in engine.buckets:
+                # One probe per readback dtype (u8 / u16 / u32 caps).
+                for probe_limit in (100, 60_000, 3_000_000_000):
+                    batch = HostBatch(
+                        slots=np.full(bucket, engine.model.num_slots, np.int32),
+                        hits=np.zeros(bucket, np.uint32),
+                        limits=np.full(bucket, probe_limit, np.uint32),
+                        fresh=np.zeros(bucket, bool),
+                        shadow=np.zeros(bucket, bool),
+                    )
+                    engine.step(batch)
 
     # -- internals -------------------------------------------------------
 

@@ -33,12 +33,26 @@ class RuntimeSnapshot:
         return self._data.get(key, "")
 
 
-def _scan(root: str, ignore_dot_files: bool) -> Dict[str, str]:
+def _scan(
+    root: str,
+    ignore_dot_files: bool,
+    prev_stats: Optional[Dict[str, tuple]] = None,
+    prev_data: Optional[Dict[str, str]] = None,
+) -> tuple:
     """Walk `root`; each file becomes key = relpath, '/'->'.', minus a
-    .yaml/.yml extension (goruntime's dotted-key convention)."""
-    out: Dict[str, str] = {}
+    .yaml/.yml extension (goruntime's dotted-key convention).
+
+    Returns ``(data, stats)`` where stats maps key ->
+    (path, mtime_ns, size).  File contents are re-read only when the
+    stat changed since `prev_stats` — the poll loop stays stat-only in
+    steady state.
+    """
+    data: Dict[str, str] = {}
+    stats: Dict[str, tuple] = {}
     if not os.path.isdir(root):
-        return out
+        return data, stats
+    prev_stats = prev_stats or {}
+    prev_data = prev_data or {}
     for dirpath, dirnames, filenames in os.walk(root, followlinks=True):
         if ignore_dot_files:
             dirnames[:] = [d for d in dirnames if not d.startswith(".")]
@@ -53,11 +67,17 @@ def _scan(root: str, ignore_dot_files: bool) -> Dict[str, str]:
                     key = key[: -len(ext)]
                     break
             try:
-                with open(path, "r", encoding="utf-8") as f:
-                    out[key] = f.read()
+                st = os.stat(path)
+                stat = (path, st.st_mtime_ns, st.st_size)
+                if prev_stats.get(key) == stat and key in prev_data:
+                    data[key] = prev_data[key]
+                else:
+                    with open(path, "r", encoding="utf-8") as f:
+                        data[key] = f.read()
+                stats[key] = stat
             except OSError:
                 continue  # raced with a writer; next poll catches it
-    return out
+    return data, stats
 
 
 class RuntimeLoader:
@@ -86,7 +106,7 @@ class RuntimeLoader:
         self.poll_interval = poll_interval
         self._callbacks: List[Callable[[], None]] = []
         self._lock = threading.Lock()
-        self._data = _scan(self.root, ignore_dot_files)
+        self._data, self._stats = _scan(self.root, ignore_dot_files)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -98,11 +118,17 @@ class RuntimeLoader:
         self._callbacks.append(fn)
 
     def force_update(self) -> bool:
-        """Rescan now; fire callbacks and return True if changed."""
-        new = _scan(self.root, self.ignore_dot_files)
+        """Rescan now; fire callbacks and return True if changed.
+        Steady-state cost is one stat() per file (contents re-read only
+        on stat change — mtime/size)."""
         with self._lock:
-            changed = new != self._data
-            self._data = new
+            prev_stats, prev_data = self._stats, self._data
+        new_data, new_stats = _scan(
+            self.root, self.ignore_dot_files, prev_stats, prev_data
+        )
+        with self._lock:
+            changed = new_data != self._data
+            self._data, self._stats = new_data, new_stats
         if changed:
             for fn in list(self._callbacks):
                 fn()

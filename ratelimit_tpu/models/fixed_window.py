@@ -105,6 +105,34 @@ class FixedWindowModel:
         """
         return self.update(counts, batch)
 
+    @functools.partial(
+        jax.jit, static_argnums=(0, 2), donate_argnums=1
+    )
+    def step_counters_compact(
+        self, counts: jax.Array, out_dtype: str, batch: DeviceBatch
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Counter update with SATURATED narrow readback.
+
+        ``afters`` clamped to ``limit + hits`` loses no information:
+        - over-limit:   after > limit  <=>  sat > limit (sat <= after,
+          and after > limit implies sat >= min(after, limit+1) > limit
+          for hits >= 1);
+        - fully-over:   before >= limit  <=>  after >= limit + hits
+          <=>  sat == limit + hits  <=>  sat - hits >= limit;
+        - partly-over:  limit < after < limit + hits  =>  sat == after
+          (exact), so ``after - limit`` attribution is exact;
+        - OK branch:    after <= limit < limit + hits  =>  sat == after,
+          so remaining and near-limit attribution are exact.
+        The host runs the identical decide_batch on the saturated
+        values.  Callers pick out_dtype ("uint8"/"uint16") only when
+        every lane satisfies ``limit + hits <= dtype max`` — then the
+        clamp cannot wrap and readback shrinks 4x/2x vs uint32.
+        """
+        counts, afters = self.update(counts, batch)
+        cap = batch.limits + batch.hits.astype(jnp.uint32)
+        sat = jnp.minimum(afters, cap)
+        return counts, sat.astype(jnp.dtype(out_dtype))
+
     def update(
         self, counts: jax.Array, batch: DeviceBatch
     ) -> Tuple[jax.Array, jax.Array]:

@@ -65,25 +65,26 @@ class ShardedFixedWindowModel:
 
         counts_spec = NamedSharding(mesh, P(self.axis, None))
         repl = NamedSharding(mesh, P())
-        shard_map = jax.shard_map
-
-        def build(body):
-            return jax.jit(
-                shard_map(
-                    body,
-                    mesh=mesh,
-                    in_specs=(P(self.axis, None), P()),
-                    out_specs=(P(self.axis, None), P()),
-                ),
-                in_shardings=(counts_spec, repl),
-                out_shardings=(counts_spec, repl),
-                donate_argnums=0,
-            )
-
-        self._step = build(self._bank_step)
-        self._step_counters = build(self._bank_update)
+        self._step = self._build(self._bank_step)
+        self._step_counters = self._build(self._bank_update)
+        self._compact_fns: dict = {}
         self._counts_sharding = counts_spec
         self._batch_sharding = repl
+
+    def _build(self, body):
+        counts_spec = NamedSharding(self.mesh, P(self.axis, None))
+        repl = NamedSharding(self.mesh, P())
+        return jax.jit(
+            jax.shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=(P(self.axis, None), P()),
+                out_specs=(P(self.axis, None), P()),
+            ),
+            in_shardings=(counts_spec, repl),
+            out_shardings=(counts_spec, repl),
+            donate_argnums=0,
+        )
 
     def init_state(self) -> jax.Array:
         """Fresh sharded counter table: (num_banks, slots_per_bank)."""
@@ -103,6 +104,26 @@ class ShardedFixedWindowModel:
         """Counter update only; returns (counts, afters) — the serving
         fast path (see models/fixed_window.py step_counters)."""
         return self._step_counters(counts, batch)
+
+    def step_counters_compact(
+        self, counts: jax.Array, out_dtype: str, batch: DeviceBatch
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Saturated narrow readback over the mesh (see
+        FixedWindowModel.step_counters_compact for the exactness
+        argument).  Non-owned lanes are already 0, so the psum of the
+        narrow values still selects the single owner without wrap."""
+        fn = self._compact_fns.get(out_dtype)
+        if fn is None:
+
+            def body(counts, batch, _dt=out_dtype):
+                counts, afters, owned = self._bank_core(counts, batch)
+                cap = batch.limits + batch.hits.astype(jnp.uint32)
+                sat = jnp.minimum(afters, cap)
+                sat = jnp.where(owned, sat, jnp.uint32(0)).astype(jnp.dtype(_dt))
+                return counts, jax.lax.psum(sat, self.axis)
+
+            fn = self._compact_fns[out_dtype] = self._build(body)
+        return fn(counts, batch)
 
     # -- per-bank SPMD bodies (run on every chip under shard_map) -------
 

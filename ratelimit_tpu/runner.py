@@ -99,6 +99,7 @@ def create_limiter(s: Settings, stats_manager: Manager, local_cache, time_source
             cache_key_prefix=s.cache_key_prefix,
             batch_window_us=s.tpu_batch_window_us,
             batch_limit=s.tpu_batch_limit,
+            dispatch_timeout_s=s.tpu_dispatch_timeout_s,
         )
     raise ValueError(f"Invalid setting for BackendType: {s.backend_type}")
 
@@ -150,6 +151,9 @@ class Runner:
 
         time_source = RealTimeSource()
         self.cache = create_limiter(s, self.stats_manager, local_cache, time_source)
+        if s.tpu_warmup and hasattr(self.cache, "warmup"):
+            logger.warning("warming up kernel shapes (TPU_WARMUP=true)...")
+            self.cache.warmup()
 
         self.runtime = RuntimeLoader(
             s.runtime_path,

@@ -13,6 +13,7 @@ registration (method path
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from concurrent import futures
 from typing import Optional
@@ -77,6 +78,9 @@ def _ratelimit_handler(service, reporter: Optional[ServerReporter]):
     )
 
 
+MAX_WATCH_STREAMS = 4
+
+
 def _health_handler(health: HealthChecker):
     def status():
         return (
@@ -88,16 +92,28 @@ def _health_handler(health: HealthChecker):
     def check(request, context):
         return health_pb2.HealthCheckResponse(status=status())
 
+    # Each Watch stream occupies a worker thread for its lifetime
+    # (grpcio sync-server model), so the count is capped to keep the
+    # pool available for ShouldRateLimit; waiting is event-driven via
+    # the HealthChecker condition, not sleep-polling.
+    watch_slots = threading.BoundedSemaphore(MAX_WATCH_STREAMS)
+
     def watch(request, context):
-        # Minimal Watch: emit the current status, then follow changes
-        # by polling; terminates with the connection.
-        last = None
-        while context.is_active():
-            cur = status()
-            if cur != last:
-                yield health_pb2.HealthCheckResponse(status=cur)
-                last = cur
-            time.sleep(1.0)
+        if not watch_slots.acquire(blocking=False):
+            context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                f"too many health watch streams (max {MAX_WATCH_STREAMS})",
+            )
+        try:
+            version = health.version()
+            yield health_pb2.HealthCheckResponse(status=status())
+            while context.is_active():
+                new_version = health.wait_for_change(version, timeout=30.0)
+                if new_version != version:
+                    version = new_version
+                    yield health_pb2.HealthCheckResponse(status=status())
+        finally:
+            watch_slots.release()
 
     return grpc.method_handlers_generic_handler(
         HEALTH_SERVICE,
@@ -146,4 +162,9 @@ def create_grpc_server(
         (_ratelimit_handler(service, reporter), _health_handler(health))
     )
     server.bound_port = server.add_insecure_port(f"{host}:{port}")
+    if server.bound_port == 0:
+        # grpcio reports bind failure as port 0 instead of raising;
+        # fail startup like the reference's net.Listen would
+        # (server_impl.go:155-162) rather than serving nothing.
+        raise OSError(f"failed to bind gRPC listener on {host}:{port}")
     return server

@@ -11,17 +11,40 @@ import threading
 class HealthChecker:
     def __init__(self, name: str = "ratelimit"):
         self.name = name
-        self._ok = threading.Event()
-        self._ok.set()
+        self._cond = threading.Condition()
+        self._healthy = True
+        self._version = 0  # bumps on every state change (Watch wakeups)
 
     @property
     def healthy(self) -> bool:
-        return self._ok.is_set()
+        with self._cond:
+            return self._healthy
 
     def fail(self) -> None:
         """Mark unhealthy (health.go:49-52)."""
-        self._ok.clear()
+        self._set(False)
 
     def ok(self) -> None:
         """Mark healthy (health.go:54-57)."""
-        self._ok.set()
+        self._set(True)
+
+    def _set(self, healthy: bool) -> None:
+        with self._cond:
+            if self._healthy != healthy:
+                self._healthy = healthy
+                self._version += 1
+                self._cond.notify_all()
+
+    def version(self) -> int:
+        with self._cond:
+            return self._version
+
+    def wait_for_change(self, last_version: int, timeout: float) -> int:
+        """Block until the state version moves past `last_version` or
+        the timeout lapses; returns the current version.  Event-driven
+        replacement for sleep-polling in health Watch streams."""
+        with self._cond:
+            self._cond.wait_for(
+                lambda: self._version != last_version, timeout=timeout
+            )
+            return self._version

@@ -137,6 +137,12 @@ class Settings:
     # settings.go:71-77; radix defaults to a 150us window).
     tpu_batch_window_us: int = 200
     tpu_batch_limit: int = 4096
+    # Liveness backstop for RPCs waiting on the dispatcher; generous
+    # default because first-batch XLA compilation can take tens of
+    # seconds on large meshes (see TpuRateLimitCache.warmup).
+    tpu_dispatch_timeout_s: float = 120.0
+    # Pre-compile every (bucket, dtype) kernel shape at startup.
+    tpu_warmup: bool = False
 
     # Global shadow mode (settings.go:105).
     global_shadow_mode: bool = False
@@ -186,6 +192,8 @@ def new_settings() -> Settings:
         ),
         tpu_batch_window_us=_env_int("TPU_BATCH_WINDOW_US", 200),
         tpu_batch_limit=_env_int("TPU_BATCH_LIMIT", 4096),
+        tpu_dispatch_timeout_s=_env_float("TPU_DISPATCH_TIMEOUT_S", 120.0),
+        tpu_warmup=_env_bool("TPU_WARMUP", False),
         global_shadow_mode=_env_bool("SHADOW_MODE", False),
     )
     return s

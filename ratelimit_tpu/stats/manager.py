@@ -139,6 +139,16 @@ class StatsStore:
             items = list(self._timers.items())
         return {name: t.summary() for name, t in items}
 
+    def live_counters(self) -> list:
+        """Live Counter objects (drain-oriented export; statsd)."""
+        with self._lock:
+            return list(self._counters.values())
+
+    def live_timers(self) -> list:
+        """Live Timer objects (drain-oriented export; statsd)."""
+        with self._lock:
+            return list(self._timers.values())
+
     def counter(self, name: str) -> Counter:
         with self._lock:
             c = self._counters.get(name)

@@ -52,9 +52,8 @@ class StatsdExporter:
     def flush(self) -> None:
         """One export cycle (also the deterministic test hook)."""
         lines = []
-        with self.store._lock:
-            counters = list(self.store._counters.values())
-            timers = list(self.store._timers.values())
+        counters = self.store.live_counters()
+        timers = self.store.live_timers()
         for c in counters:
             delta = c.drain_delta()
             if delta:

@@ -1,0 +1,89 @@
+"""Saturated narrow readback: decisions must be bit-identical to the
+uint32 path (the exactness argument in
+FixedWindowModel.step_counters_compact)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ratelimit_tpu.backends.engine import CounterEngine, HostBatch, _decide_host
+from ratelimit_tpu.models.fixed_window import DeviceBatch, FixedWindowModel
+from ratelimit_tpu.parallel import ShardedFixedWindowModel, make_mesh
+
+NUM_SLOTS = 64
+
+
+def _batch(rng, n, max_limit, max_hits):
+    return dict(
+        slots=rng.integers(0, NUM_SLOTS + 1, n).astype(np.int32),
+        hits=rng.integers(1, max_hits + 1, n).astype(np.uint32),
+        limits=rng.integers(1, max_limit + 1, n).astype(np.uint32),
+        fresh=rng.random(n) < 0.1,
+        shadow=rng.random(n) < 0.2,
+    )
+
+
+@pytest.mark.parametrize(
+    "dtype,max_limit,max_hits",
+    [("uint8", 200, 5), ("uint16", 60000, 400)],
+)
+def test_compact_saturation_exact(dtype, max_limit, max_hits):
+    """Drive counters far past the limit; saturated readback must give
+    the same host decisions as the full uint32 readback."""
+    model_full = FixedWindowModel(NUM_SLOTS)
+    model_compact = FixedWindowModel(NUM_SLOTS)
+    c_full = model_full.init_state()
+    c_comp = model_compact.init_state()
+    rng = np.random.default_rng(11)
+
+    for step in range(8):
+        raw = _batch(rng, 32, max_limit, max_hits)
+        db = DeviceBatch(**{k: jnp.asarray(v) for k, v in raw.items()})
+        hb = HostBatch(**raw)
+
+        c_full, full = model_full.step_counters(c_full, db)
+        c_comp, comp = model_compact.step_counters_compact(c_comp, dtype, db)
+        assert np.asarray(comp).dtype == np.dtype(dtype)
+
+        d_full = _decide_host(jax.device_get(full), hb, 0, 32, 0.8)
+        d_comp = _decide_host(jax.device_get(comp), hb, 0, 32, 0.8)
+        for f in ("codes", "limit_remaining", "over_limit", "near_limit",
+                  "within_limit", "shadow_mode", "set_local_cache"):
+            np.testing.assert_array_equal(
+                getattr(d_comp, f), getattr(d_full, f), err_msg=f"step {step} {f}"
+            )
+        np.testing.assert_array_equal(np.asarray(c_full), np.asarray(c_comp))
+
+
+def test_sharded_compact_matches_single():
+    mesh = make_mesh(8)
+    sharded = ShardedFixedWindowModel(NUM_SLOTS, mesh)
+    single = FixedWindowModel(NUM_SLOTS)
+    sc, cc = sharded.init_state(), single.init_state()
+    rng = np.random.default_rng(5)
+    for _ in range(4):
+        raw = _batch(rng, 24, 200, 4)
+        db = DeviceBatch(**{k: jnp.asarray(v) for k, v in raw.items()})
+        sc, a1 = sharded.step_counters_compact(sc, "uint8", db)
+        cc, a2 = single.step_counters_compact(cc, "uint8", db)
+        np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+
+
+def test_engine_picks_compact_by_limits():
+    """Engine decisions are unchanged whether limits force the uint32,
+    uint16 or uint8 readback path."""
+    rng = np.random.default_rng(3)
+    engines = [CounterEngine(num_slots=NUM_SLOTS, buckets=(32,)) for _ in range(3)]
+    for max_limit, engine in zip((200, 60000, 3_000_000_000), engines):
+        hb = HostBatch(
+            slots=np.arange(16, dtype=np.int32),
+            hits=np.ones(16, dtype=np.uint32),
+            limits=np.full(16, max_limit, dtype=np.uint32),
+            fresh=np.zeros(16, dtype=bool),
+            shadow=np.zeros(16, dtype=bool),
+        )
+        d = engine.step(hb)
+        assert (d.codes == 1).all()
+        np.testing.assert_array_equal(d.afters, np.ones(16))
