@@ -1,0 +1,20 @@
+# Serving image (reference Dockerfile analog: static binary -> alpine;
+# here: CPU jax by default — swap the jax wheel for a TPU build via
+# JAX_EXTRA at build time on TPU hosts).
+FROM python:3.12-slim
+
+ARG JAX_EXTRA=jax
+RUN pip install --no-cache-dir ${JAX_EXTRA} numpy pyyaml grpcio protobuf
+
+WORKDIR /app
+COPY ratelimit_tpu/ ratelimit_tpu/
+COPY pyproject.toml .
+
+ENV RUNTIME_ROOT=/data/ratelimit \
+    RUNTIME_SUBDIRECTORY=config_root \
+    USE_STATSD=false
+
+# 8080 HTTP/json, 8081 gRPC, 6070 debug (reference server_impl.go).
+EXPOSE 8080 8081 6070
+
+CMD ["python", "-m", "ratelimit_tpu.runner"]

@@ -1,0 +1,49 @@
+# Developer entry points (reference Makefile analog: 3 binaries ->
+# python -m entry points; test tiers; docker packaging).
+
+PY ?= python
+CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
+
+.PHONY: all test bench protos serve check_config smoke_client docker_image e2e clean
+
+all: test
+
+# Tier 1+2: unit + in-process integration (runs on an 8-device virtual
+# CPU mesh; no TPU needed).
+test:
+	$(PY) -m pytest tests/ -q
+
+# Headline benchmark on the default JAX device (real chip under axon).
+bench:
+	$(PY) bench.py
+
+# Regenerate committed protobuf classes after editing protos/.
+protos:
+	sh scripts/gen_protos.sh
+
+# Local dev server against the example config.
+serve:
+	RUNTIME_ROOT=examples RUNTIME_SUBDIRECTORY=ratelimit USE_STATSD=false \
+	LOG_LEVEL=INFO $(PY) -m ratelimit_tpu.runner
+
+# Offline config validation (reference config_check_cmd).
+check_config:
+	$(PY) -m ratelimit_tpu.cli.config_check --config_dir examples/ratelimit/config
+
+# One smoke RPC against a running server (reference client_cmd).
+smoke_client:
+	$(PY) -m ratelimit_tpu.cli.client --dial_string localhost:8081 \
+	  --domain rl --descriptors foo=bar
+
+docker_image:
+	docker build -t ratelimit-tpu:latest .
+
+# Black-box e2e: compose stack (ratelimit + statsd-exporter + envoy),
+# then the scripted scenarios (reference integration-test/ analog).
+e2e:
+	docker compose -f docker-compose-example.yml up --build -d
+	sh integration-test/run-all.sh
+	docker compose -f docker-compose-example.yml down
+
+clean:
+	find . -name __pycache__ -type d -prune -exec rm -rf {} \;

@@ -1,0 +1,10 @@
+#!/bin/sh
+# Black-box e2e scenarios against the compose stack (reference
+# integration-test/run-all.sh analog): runs every script in scripts/.
+set -e
+cd "$(dirname "$0")"
+for script in scripts/*.sh; do
+  echo "=== $script"
+  sh "$script"
+done
+echo "ALL E2E SCENARIOS PASSED"

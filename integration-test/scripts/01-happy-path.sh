@@ -1,0 +1,6 @@
+#!/bin/sh
+# First request through envoy reaches the upstream (200).
+set -e
+code=$(curl -s -o /dev/null -w "%{http_code}" http://localhost:8888/)
+[ "$code" = "200" ] || { echo "expected 200, got $code"; exit 1; }
+echo ok

@@ -1,0 +1,11 @@
+#!/bin/sh
+# The foo/bar descriptor is limited to 3/minute: the 4th request with
+# the header must come back 429 (reference trigger-ratelimit.sh).
+set -e
+last=0
+for i in 1 2 3 4 5; do
+  last=$(curl -s -o /dev/null -w "%{http_code}" \
+    -H "x-ratelimit-key: bar" http://localhost:8888/)
+done
+[ "$last" = "429" ] || { echo "expected 429 after quota, got $last"; exit 1; }
+echo ok

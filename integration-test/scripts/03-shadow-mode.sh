@@ -1,0 +1,14 @@
+#!/bin/sh
+# trial_rollout is shadow_mode: hammering it must NEVER 429 (reference
+# trigger-shadow-mode-key.sh), while the service still counts hits
+# (check the stat on the debug port).
+set -e
+for i in $(seq 1 15); do
+  code=$(curl -s -o /dev/null -w "%{http_code}" -XPOST --data \
+    '{"domain":"rl","descriptors":[{"entries":[{"key":"trial_rollout","value":"x"}]}]}' \
+    http://localhost:8080/json)
+  [ "$code" = "200" ] || { echo "shadow mode returned $code"; exit 1; }
+done
+curl -s http://localhost:6070/stats | grep -q "trial_rollout.*shadow_mode: [1-9]" \
+  || { echo "shadow_mode stat not incremented"; exit 1; }
+echo ok

@@ -1,0 +1,199 @@
+"""Counter-state checkpoint/restore.
+
+The reference has no checkpointing: durable state is the counters in
+Redis with TTL = window, and a restart just reconnects (SURVEY.md
+section 5 "Checkpoint / resume").  The TPU engine keeps counters in
+HBM, so a process restart would forgive every open window — this
+module closes that gap: periodic atomic snapshots of (counter table,
+slot table) per engine bank, restored on startup.
+
+Restore correctness needs no window bookkeeping: cache keys embed
+their window start, so restored keys whose window has passed simply
+expire via the slot table's normal gc/expiry path, and a slot whose
+key is gone is zeroed on reassignment (the batch `fresh` flag).  A
+crash between snapshots forgives at most `interval_s` worth of hits —
+the same failure envelope as Redis with async persistence.
+
+Snapshots are taken on the dispatcher thread (the slot table owner)
+via BatchDispatcher.run_on_thread, so they are consistent without a
+global lock on the serving path.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger("ratelimit.checkpoint")
+
+FORMAT_VERSION = 1
+
+
+def snapshot_engine(engine) -> tuple:
+    """Copy one bank's state: (counts, entries).  This is the only
+    part that needs exclusive access to the engine; serialization and
+    disk I/O happen afterwards on the caller's thread."""
+    return engine.export_counts(), engine.slot_table.entries()
+
+
+def write_snapshot(path: str, num_slots: int, counts, entries) -> None:
+    """Serialize + atomically write a snapshot (no pickle: keys are
+    stored as concatenated utf-8 bytes + a length array, so restore
+    can run with allow_pickle=False on untrusted files)."""
+    key_bytes = [e[0].encode("utf-8") for e in entries]
+    key_lens = np.array([len(b) for b in key_bytes], dtype=np.int64)
+    key_blob = np.frombuffer(b"".join(key_bytes), dtype=np.uint8)
+    slots = np.array([e[1] for e in entries], dtype=np.int64)
+    expiries = np.array([e[2] for e in entries], dtype=np.int64)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    meta = json.dumps(
+        {
+            "version": FORMAT_VERSION,
+            "num_slots": num_slots,
+            "saved_at": time.time(),
+        }
+    )
+    with open(tmp, "wb") as f:
+        np.savez_compressed(
+            f,
+            meta=np.frombuffer(meta.encode(), dtype=np.uint8),
+            counts=counts,
+            key_lens=key_lens,
+            key_blob=key_blob,
+            slots=slots,
+            expiries=expiries,
+        )
+    os.replace(tmp, path)
+
+
+def save_engine(engine, path: str) -> None:
+    """snapshot_engine + write_snapshot in one call (tests, shutdown).
+    Callers on the serving path should copy under exclusivity and
+    write outside it — see CheckpointManager.checkpoint."""
+    counts, entries = snapshot_engine(engine)
+    write_snapshot(path, engine.model.num_slots, counts, entries)
+
+
+def restore_engine(engine, path: str) -> bool:
+    """Restore one engine bank from `path`; returns False (and leaves
+    the engine fresh) if the snapshot is missing or incompatible."""
+    if not os.path.exists(path):
+        return False
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            meta = json.loads(bytes(z["meta"]).decode())
+            if meta.get("version") != FORMAT_VERSION:
+                logger.warning("checkpoint %s: unknown version, skipping", path)
+                return False
+            if meta.get("num_slots") != engine.model.num_slots:
+                logger.warning(
+                    "checkpoint %s: num_slots %s != engine %s, skipping",
+                    path,
+                    meta.get("num_slots"),
+                    engine.model.num_slots,
+                )
+                return False
+            counts = z["counts"]
+            blob = bytes(z["key_blob"])
+            keys = []
+            off = 0
+            for n in z["key_lens"].tolist():
+                keys.append(blob[off : off + n].decode("utf-8"))
+                off += n
+            entries = list(
+                zip(keys, z["slots"].tolist(), z["expiries"].tolist())
+            )
+    except Exception as e:
+        logger.warning("checkpoint %s unreadable (%s), starting fresh", path, e)
+        return False
+
+    from .slot_table import SlotTable
+
+    engine.import_counts(counts.astype(np.uint32))
+    engine.slot_table = SlotTable.from_entries(engine.model.num_slots, entries)
+    logger.warning(
+        "restored %d live keys from %s (saved %.0fs ago)",
+        len(entries),
+        path,
+        time.time() - meta.get("saved_at", 0),
+    )
+    return True
+
+
+class CheckpointManager:
+    """Periodic background snapshots of a TpuRateLimitCache's banks."""
+
+    def __init__(self, cache, directory: str, interval_s: float = 30.0):
+        if interval_s <= 0:
+            raise ValueError(
+                f"checkpoint interval must be positive, got {interval_s} "
+                "(leave TPU_CHECKPOINT_DIR empty to disable checkpointing)"
+            )
+        self.cache = cache
+        self.directory = directory
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        os.makedirs(directory, exist_ok=True)
+
+    def _bank_path(self, idx: int) -> str:
+        return os.path.join(self.directory, f"bank{idx}.npz")
+
+    def restore(self) -> int:
+        """Restore all banks; returns how many were restored."""
+        restored = 0
+        for idx, engine in enumerate(self.cache.engines()):
+            if restore_engine(engine, self._bank_path(idx)):
+                restored += 1
+        return restored
+
+    def checkpoint(self) -> None:
+        """Snapshot all banks now.  Only the state COPY runs under
+        engine exclusivity (dispatcher thread / inline lock); the
+        expensive compression + disk write happen on this thread so
+        serving stalls only for the memcpy, not the I/O."""
+        for idx, engine in enumerate(self.cache.engines()):
+            grabbed = {}
+
+            def grab(e=engine, out=grabbed):
+                out["counts"], out["entries"] = snapshot_engine(e)
+
+            self.cache.run_exclusive(engine, grab)
+            write_snapshot(
+                self._bank_path(idx),
+                engine.model.num_slots,
+                grabbed["counts"],
+                grabbed["entries"],
+            )
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="checkpointer", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, final_checkpoint: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        if final_checkpoint:
+            try:
+                self.checkpoint()
+            except Exception:
+                logger.exception("final checkpoint failed")
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.checkpoint()
+            except Exception:
+                logger.exception("periodic checkpoint failed")

@@ -73,6 +73,18 @@ class _FlushToken:
         self.event = threading.Event()
 
 
+class _CallToken:
+    """Run an arbitrary fn on the dispatcher thread (the slot-table
+    owner) — used for consistent checkpoints without a serving lock."""
+
+    __slots__ = ("fn", "event", "error")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.event = threading.Event()
+        self.error = None
+
+
 _STOP = object()
 
 
@@ -161,6 +173,16 @@ class BatchDispatcher:
         self._q.put(token)
         token.event.wait()
 
+    def run_on_thread(self, fn, timeout: float = 120.0):
+        """Execute `fn()` on the dispatcher thread, after everything
+        already queued; blocks for the result."""
+        token = _CallToken(fn)
+        self._q.put(token)
+        if not token.event.wait(timeout):
+            raise TimeoutError("dispatcher did not run the call in time")
+        if token.error is not None:
+            raise token.error
+
     def stop(self) -> None:
         self._q.put(_STOP)
         self._thread.join(timeout=10)
@@ -181,9 +203,9 @@ class BatchDispatcher:
             if obj is _STOP:
                 stopping = True
                 break
-            if isinstance(obj, _FlushToken):
+            if isinstance(obj, (_FlushToken, _CallToken)):
                 tokens.append(obj)
-                break  # flush short-circuits the window
+                break  # flush/call short-circuits the window
             batch.append(obj)
             lanes += len(obj.lanes)
             if lanes >= self.batch_limit:
@@ -203,10 +225,19 @@ class BatchDispatcher:
             if batch:
                 run_items(self.engine, batch)
             for t in tokens:
-                t.event.set()
+                self._complete_token(t)
             if stopping:
                 self._drain()
                 return
+
+    @staticmethod
+    def _complete_token(t) -> None:
+        if isinstance(t, _CallToken):
+            try:
+                t.fn()
+            except BaseException as e:
+                t.error = e
+        t.event.set()
 
     def _drain(self) -> None:
         """Complete everything still queued at stop time so no waiter
@@ -219,10 +250,10 @@ class BatchDispatcher:
                 break
             if isinstance(obj, WorkItem):
                 leftovers.append(obj)
-            elif isinstance(obj, _FlushToken):
+            elif isinstance(obj, (_FlushToken, _CallToken)):
                 if leftovers:
                     run_items(self.engine, leftovers)
                     leftovers = []
-                obj.event.set()
+                self._complete_token(obj)
         if leftovers:
             run_items(self.engine, leftovers)

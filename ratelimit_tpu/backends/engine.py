@@ -206,3 +206,20 @@ class CounterEngine:
             counts = jax.device_put(counts, self._device)
         self._counts = counts
         self.slot_table = SlotTable(self.model.num_slots)
+
+    # -- checkpoint surface (backends/checkpoint.py) --------------------
+
+    def export_counts(self) -> np.ndarray:
+        """Flat uint32 copy of the counter table."""
+        return np.asarray(jax.device_get(self._counts)).reshape(-1)
+
+    def import_counts(self, counts: np.ndarray) -> None:
+        arr = np.asarray(counts, dtype=np.uint32).reshape(-1)
+        if arr.shape[0] != self.model.num_slots:
+            raise ValueError(
+                f"counts size {arr.shape[0]} != num_slots {self.model.num_slots}"
+            )
+        put = jax.numpy.asarray(arr)
+        if self._device is not None:
+            put = jax.device_put(put, self._device)
+        self._counts = put

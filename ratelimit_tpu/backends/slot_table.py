@@ -69,6 +69,27 @@ class SlotTable:
         self._batch_active = False
         self._pinned.clear()
 
+    def entries(self) -> List[Tuple[str, int, int]]:
+        """Live (key, slot, expiry) triples (checkpoint export)."""
+        return [(k, s, e) for k, (s, e) in self._map.items()]
+
+    @classmethod
+    def from_entries(
+        cls, num_slots: int, entries: List[Tuple[str, int, int]]
+    ) -> "SlotTable":
+        """Rebuild a table from checkpointed entries (restore path)."""
+        t = cls(num_slots)
+        used = set()
+        for key, slot, expiry in entries:
+            slot = int(slot)
+            if slot < 0 or slot >= num_slots or slot in used:
+                continue  # corrupt/duplicate entry: drop, don't crash
+            used.add(slot)
+            t._map[key] = (slot, int(expiry))
+            heapq.heappush(t._heap, (int(expiry), key))
+        t._free = [s for s in range(num_slots - 1, -1, -1) if s not in used]
+        return t
+
     def gc(self, now: int) -> int:
         """Reclaim slots of expired keys; returns how many were freed."""
         freed = 0

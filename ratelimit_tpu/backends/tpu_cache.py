@@ -218,6 +218,24 @@ class TpuRateLimitCache:
         for d in dispatchers:
             d.stop()
 
+    def engines(self):
+        """All live counter banks, main first (checkpoint surface)."""
+        out = [self.engine]
+        if self.per_second_engine is not None:
+            out.append(self.per_second_engine)
+        return out
+
+    def run_exclusive(self, engine, fn) -> None:
+        """Run `fn()` with exclusive access to `engine`'s slot table
+        and counts: on the dispatcher thread when batching is on,
+        under the inline lock otherwise."""
+        d = self._dispatchers.get(id(engine))
+        if d is not None:
+            d.run_on_thread(fn)
+        else:
+            with self._inline_locks[id(engine)]:
+                fn()
+
     def warmup(self) -> None:
         """Pre-compile every (bucket, readback-dtype) kernel shape so
         the first real RPC never pays XLA compilation.  Uses inert

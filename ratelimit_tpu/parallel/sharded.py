@@ -199,3 +199,14 @@ class ShardedCounterEngine(CounterEngine):
             buckets=buckets,
             model=ShardedFixedWindowModel(num_slots, mesh, near_ratio),
         )
+
+    def import_counts(self, counts) -> None:
+        arr = np.asarray(counts, dtype=np.uint32).reshape(-1)
+        m = self.model
+        if arr.shape[0] != m.num_slots:
+            raise ValueError(
+                f"counts size {arr.shape[0]} != num_slots {m.num_slots}"
+            )
+        self._counts = jax.device_put(
+            arr.reshape(m.num_banks, m.slots_per_bank), m._counts_sharding
+        )

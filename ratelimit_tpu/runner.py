@@ -117,6 +117,7 @@ class Runner:
         self.debug_server = None
         self.statsd = None
         self.health = None
+        self.checkpointer = None
 
     # -- lifecycle (runner.go:76-143) -----------------------------------
 
@@ -154,6 +155,15 @@ class Runner:
         if s.tpu_warmup and hasattr(self.cache, "warmup"):
             logger.warning("warming up kernel shapes (TPU_WARMUP=true)...")
             self.cache.warmup()
+
+        if s.tpu_checkpoint_dir and hasattr(self.cache, "engines"):
+            from .backends.checkpoint import CheckpointManager
+
+            self.checkpointer = CheckpointManager(
+                self.cache, s.tpu_checkpoint_dir, s.tpu_checkpoint_interval_s
+            )
+            self.checkpointer.restore()
+            self.checkpointer.start()
 
         self.runtime = RuntimeLoader(
             s.runtime_path,
@@ -237,6 +247,8 @@ class Runner:
                 srv.stop()
         if self.runtime is not None:
             self.runtime.stop()
+        if self.checkpointer is not None:
+            self.checkpointer.stop(final_checkpoint=True)
         if self.statsd is not None:
             self.statsd.stop()
         if self.cache is not None and hasattr(self.cache, "close"):

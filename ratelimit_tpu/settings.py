@@ -143,6 +143,10 @@ class Settings:
     tpu_dispatch_timeout_s: float = 120.0
     # Pre-compile every (bucket, dtype) kernel shape at startup.
     tpu_warmup: bool = False
+    # Counter-state checkpointing (closes the restart-amnesia gap the
+    # reference delegates to Redis durability; empty = disabled).
+    tpu_checkpoint_dir: str = ""
+    tpu_checkpoint_interval_s: float = 30.0
 
     # Global shadow mode (settings.go:105).
     global_shadow_mode: bool = False
@@ -194,6 +198,8 @@ def new_settings() -> Settings:
         tpu_batch_limit=_env_int("TPU_BATCH_LIMIT", 4096),
         tpu_dispatch_timeout_s=_env_float("TPU_DISPATCH_TIMEOUT_S", 120.0),
         tpu_warmup=_env_bool("TPU_WARMUP", False),
+        tpu_checkpoint_dir=_env_str("TPU_CHECKPOINT_DIR", ""),
+        tpu_checkpoint_interval_s=_env_float("TPU_CHECKPOINT_INTERVAL_S", 30.0),
         global_shadow_mode=_env_bool("SHADOW_MODE", False),
     )
     return s

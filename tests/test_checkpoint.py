@@ -1,0 +1,109 @@
+"""Checkpoint/restore: a restart must not forgive open windows
+(the gap called out in SURVEY.md section 5 — the reference leans on
+Redis durability; the TPU engine snapshots its HBM counters)."""
+
+import numpy as np
+
+from ratelimit_tpu.api import Code, Descriptor, RateLimitRequest
+from ratelimit_tpu.backends.checkpoint import (
+    CheckpointManager,
+    restore_engine,
+    save_engine,
+)
+from ratelimit_tpu.backends.engine import CounterEngine
+from ratelimit_tpu.backends.tpu_cache import TpuRateLimitCache
+from ratelimit_tpu.config.loader import ConfigFile, load_config
+from ratelimit_tpu.parallel import ShardedCounterEngine, make_mesh
+from ratelimit_tpu.stats.manager import Manager
+
+YAML = """
+domain: d
+descriptors:
+  - key: k
+    rate_limit:
+      unit: minute
+      requests_per_unit: 5
+"""
+
+
+def _rule(mgr):
+    return load_config([ConfigFile("config.c", YAML)], mgr).get_limit(
+        "d", Descriptor.of(("k", "x"))
+    )
+
+
+def _hit(cache, rule, n=1):
+    codes = []
+    for _ in range(n):
+        st = cache.do_limit(
+            RateLimitRequest("d", [Descriptor.of(("k", "x"))], 1), [rule]
+        )
+        codes.append(st[0].code)
+    return codes
+
+
+def test_restart_does_not_forgive_window(tmp_path, clock):
+    path = str(tmp_path / "bank0.npz")
+    cache_a = TpuRateLimitCache(CounterEngine(num_slots=64), time_source=clock)
+    rule = _rule(Manager())
+    assert _hit(cache_a, rule, 3) == [Code.OK] * 3
+    save_engine(cache_a.engine, path)
+
+    # "Restart": a fresh engine restores the snapshot and continues the
+    # same window (clock pinned): 2 more OK, then OVER_LIMIT.
+    cache_b = TpuRateLimitCache(CounterEngine(num_slots=64), time_source=clock)
+    assert restore_engine(cache_b.engine, path)
+    assert _hit(cache_b, rule, 3) == [Code.OK, Code.OK, Code.OVER_LIMIT]
+
+
+def test_restore_missing_or_mismatched(tmp_path, clock):
+    engine = CounterEngine(num_slots=64)
+    assert restore_engine(engine, str(tmp_path / "nope.npz")) is False
+
+    save_engine(engine, str(tmp_path / "bank0.npz"))
+    other = CounterEngine(num_slots=128)
+    assert restore_engine(other, str(tmp_path / "bank0.npz")) is False
+    assert len(other.slot_table) == 0
+
+
+def test_sharded_checkpoint_roundtrip(tmp_path, clock):
+    mesh = make_mesh(8)
+    path = str(tmp_path / "bank0.npz")
+    cache_a = TpuRateLimitCache(
+        ShardedCounterEngine(mesh, num_slots=64), time_source=clock
+    )
+    rule = _rule(Manager())
+    assert _hit(cache_a, rule, 4) == [Code.OK] * 4
+    save_engine(cache_a.engine, path)
+
+    cache_b = TpuRateLimitCache(
+        ShardedCounterEngine(make_mesh(8), num_slots=64), time_source=clock
+    )
+    assert restore_engine(cache_b.engine, path)
+    np.testing.assert_array_equal(
+        cache_b.engine.export_counts(), cache_a.engine.export_counts()
+    )
+    assert _hit(cache_b, rule, 2) == [Code.OK, Code.OVER_LIMIT]
+
+
+def test_checkpoint_manager_with_dispatcher(tmp_path, clock):
+    """Snapshots run on the dispatcher thread while batching is on."""
+    cache = TpuRateLimitCache(
+        CounterEngine(num_slots=64), time_source=clock, batch_window_us=200
+    )
+    try:
+        rule = _rule(Manager())
+        _hit(cache, rule, 3)
+        mgr = CheckpointManager(cache, str(tmp_path), interval_s=3600)
+        mgr.checkpoint()
+
+        fresh = TpuRateLimitCache(CounterEngine(num_slots=64), time_source=clock)
+        mgr2 = CheckpointManager(
+            TpuRateLimitCache(fresh.engine, time_source=clock),
+            str(tmp_path),
+            interval_s=3600,
+        )
+        assert mgr2.restore() == 1
+        assert _hit(fresh, rule, 3) == [Code.OK, Code.OK, Code.OVER_LIMIT]
+    finally:
+        cache.close()
