@@ -4,7 +4,12 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: all test bench protos serve check_config smoke_client docker_image e2e clean
+.PHONY: all test bench protos native serve check_config smoke_client docker_image e2e clean
+
+# C++ slot table (auto-built on first import too; this forces it).
+native:
+	g++ -O2 -std=c++17 -shared -fPIC \
+	  -o ratelimit_tpu/backends/_libslottable.so native/slot_table.cpp
 
 all: test
 

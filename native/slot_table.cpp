@@ -1,0 +1,216 @@
+// Native slot table: cache-key -> HBM-slot assignment on the serving
+// hot path.
+//
+// Same contract as the Python SlotTable (ratelimit_tpu/backends/
+// slot_table.py — the behavioral spec, kept as the differential-test
+// oracle and fallback): exact key->slot mapping, lazy-deletion expiry
+// min-heap, evict-soonest-expiring when full, batch pinning so two
+// live keys in one device batch never share a slot.  The win over the
+// Python version is batch granularity: one ctypes call assigns a whole
+// batch (keys passed as a single length-prefixed utf-8 blob), so the
+// per-descriptor interpreter cost disappears from the dispatcher
+// thread.
+//
+// The reference has no native code (SURVEY.md section 2: pure Go); the
+// analog of this component is Redis's keyspace itself — the piece of
+// the reference's hot path that lived outside Go.
+//
+// Build: make native   (g++ -O2 -shared -fPIC -> libslottable.so)
+
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <string>
+#include <unordered_map>
+#include <utility>
+#include <vector>
+
+namespace {
+
+struct HeapItem {
+  int64_t expiry;
+  std::string key;
+  bool operator>(const HeapItem& o) const {
+    if (expiry != o.expiry) return expiry > o.expiry;
+    return key > o.key;
+  }
+};
+
+struct SlotTable {
+  int64_t num_slots;
+  std::unordered_map<std::string, std::pair<int64_t, int64_t>> map;  // key -> (slot, expiry)
+  std::vector<int64_t> free_slots;  // LIFO, matches python list.pop()
+  std::priority_queue<HeapItem, std::vector<HeapItem>, std::greater<HeapItem>> heap;
+  int64_t evictions = 0;
+  // Cross-call pinning (sk_begin_batch/sk_end_batch protocol); when
+  // inactive, each sk_assign_batch call uses its own local pin set.
+  bool batch_active = false;
+  std::unordered_map<std::string, bool> persistent_pins;
+
+  explicit SlotTable(int64_t n) : num_slots(n) {
+    free_slots.reserve(n);
+    for (int64_t s = 0; s < n; ++s) free_slots.push_back(n - 1 - s);
+  }
+
+  int64_t gc(int64_t now) {
+    int64_t freed = 0;
+    while (!heap.empty() && heap.top().expiry <= now) {
+      HeapItem item = heap.top();
+      heap.pop();
+      auto it = map.find(item.key);
+      if (it != map.end() && it->second.second == item.expiry) {
+        free_slots.push_back(it->second.first);
+        map.erase(it);
+        ++freed;
+      }
+    }
+    return freed;
+  }
+
+  // Returns false when the table is exhausted (batch pins more live
+  // keys than slots).
+  bool evict_one(const std::unordered_map<std::string, bool>* pinned) {
+    std::vector<HeapItem> skipped;
+    bool ok = false;
+    while (!heap.empty()) {
+      HeapItem item = heap.top();
+      heap.pop();
+      auto it = map.find(item.key);
+      if (it == map.end() || it->second.second != item.expiry) continue;
+      if (pinned && pinned->count(item.key)) {
+        skipped.push_back(std::move(item));
+        continue;
+      }
+      free_slots.push_back(it->second.first);
+      map.erase(it);
+      ++evictions;
+      ok = true;
+      break;
+    }
+    for (auto& s : skipped) heap.push(std::move(s));
+    return ok;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* sk_create(int64_t num_slots) { return new SlotTable(num_slots); }
+
+void sk_destroy(void* t) { delete static_cast<SlotTable*>(t); }
+
+int64_t sk_len(void* t) {
+  return static_cast<int64_t>(static_cast<SlotTable*>(t)->map.size());
+}
+
+int64_t sk_evictions(void* t) { return static_cast<SlotTable*>(t)->evictions; }
+
+int64_t sk_gc(void* t, int64_t now) {
+  return static_cast<SlotTable*>(t)->gc(now);
+}
+
+// Assign a whole batch in one call.
+//   key_blob / key_lens[n]: concatenated utf-8 keys
+//   expiries[n]:            per-key expiry (ignored for known keys)
+//   out_slots[n], out_fresh[n]
+// Keys appearing twice in the batch get the same slot (second sight is
+// not fresh).  All newly-assigned keys in the batch are pinned against
+// eviction until the call returns.  Returns 0 on success, -1 when the
+// table is exhausted (more pinned live keys than slots).
+int64_t sk_assign_batch(void* tp, const uint8_t* key_blob,
+                        const int64_t* key_lens, int64_t n, int64_t now,
+                        const int64_t* expiries, int64_t* out_slots,
+                        uint8_t* out_fresh) {
+  SlotTable* t = static_cast<SlotTable*>(tp);
+  std::unordered_map<std::string, bool> local_pins;
+  std::unordered_map<std::string, bool>& pinned =
+      t->batch_active ? t->persistent_pins : local_pins;
+  const uint8_t* p = key_blob;
+  for (int64_t i = 0; i < n; ++i) {
+    std::string key(reinterpret_cast<const char*>(p), key_lens[i]);
+    p += key_lens[i];
+    auto it = t->map.find(key);
+    if (it != t->map.end()) {
+      // Existing keys are pinned too: their slot was handed out in
+      // this batch and must not be evicted for a later lane.
+      out_slots[i] = it->second.first;
+      out_fresh[i] = 0;
+      pinned.emplace(std::move(key), true);
+      continue;
+    }
+    if (t->free_slots.empty()) t->gc(now);
+    if (t->free_slots.empty() && !t->evict_one(&pinned)) return -1;
+    int64_t slot = t->free_slots.back();
+    t->free_slots.pop_back();
+    t->map.emplace(key, std::make_pair(slot, expiries[i]));
+    t->heap.push(HeapItem{expiries[i], key});
+    pinned.emplace(std::move(key), true);
+    out_slots[i] = slot;
+    out_fresh[i] = 1;
+  }
+  return 0;
+}
+
+void sk_begin_batch(void* tp) {
+  SlotTable* t = static_cast<SlotTable*>(tp);
+  t->batch_active = true;
+  t->persistent_pins.clear();
+}
+
+void sk_end_batch(void* tp) {
+  SlotTable* t = static_cast<SlotTable*>(tp);
+  t->batch_active = false;
+  t->persistent_pins.clear();
+}
+
+// Checkpoint export: call once with null buffers to get sizes, then
+// with buffers of (total_key_bytes, n, n, n).
+int64_t sk_export_size(void* tp, int64_t* out_total_key_bytes) {
+  SlotTable* t = static_cast<SlotTable*>(tp);
+  int64_t bytes = 0;
+  for (const auto& kv : t->map) bytes += static_cast<int64_t>(kv.first.size());
+  *out_total_key_bytes = bytes;
+  return static_cast<int64_t>(t->map.size());
+}
+
+void sk_export(void* tp, uint8_t* key_blob, int64_t* key_lens,
+               int64_t* slots, int64_t* expiries) {
+  SlotTable* t = static_cast<SlotTable*>(tp);
+  uint8_t* p = key_blob;
+  int64_t i = 0;
+  for (const auto& kv : t->map) {
+    std::memcpy(p, kv.first.data(), kv.first.size());
+    p += kv.first.size();
+    key_lens[i] = static_cast<int64_t>(kv.first.size());
+    slots[i] = kv.second.first;
+    expiries[i] = kv.second.second;
+    ++i;
+  }
+}
+
+// Checkpoint import: bulk-load entries into a fresh table.  Invalid or
+// duplicate slots are skipped.  Returns how many entries were loaded.
+int64_t sk_import(void* tp, const uint8_t* key_blob, const int64_t* key_lens,
+                  const int64_t* slots, const int64_t* expiries, int64_t n) {
+  SlotTable* t = static_cast<SlotTable*>(tp);
+  std::vector<uint8_t> used(t->num_slots, 0);
+  const uint8_t* p = key_blob;
+  int64_t loaded = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    std::string key(reinterpret_cast<const char*>(p), key_lens[i]);
+    p += key_lens[i];
+    int64_t slot = slots[i];
+    if (slot < 0 || slot >= t->num_slots || used[slot]) continue;
+    used[slot] = 1;
+    t->heap.push(HeapItem{expiries[i], key});
+    t->map.emplace(std::move(key), std::make_pair(slot, expiries[i]));
+    ++loaded;
+  }
+  t->free_slots.clear();
+  for (int64_t s = t->num_slots - 1; s >= 0; --s)
+    if (!used[s]) t->free_slots.push_back(s);
+  return loaded;
+}
+
+}  // extern "C"

@@ -113,10 +113,9 @@ def restore_engine(engine, path: str) -> bool:
         logger.warning("checkpoint %s unreadable (%s), starting fresh", path, e)
         return False
 
-    from .slot_table import SlotTable
-
     engine.import_counts(counts.astype(np.uint32))
-    engine.slot_table = SlotTable.from_entries(engine.model.num_slots, entries)
+    table_cls = type(engine.slot_table)
+    engine.slot_table = table_cls.from_entries(engine.model.num_slots, entries)
     logger.warning(
         "restored %d live keys from %s (saved %.0fs ago)",
         len(entries),

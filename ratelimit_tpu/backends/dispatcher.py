@@ -105,28 +105,29 @@ def run_items(engine, items: List[WorkItem]) -> None:
         for it in items:
             it.event.set()
         return
-    slots = np.empty(total, dtype=np.int32)
+    keys: List[str] = []
+    expiries: List[int] = []
     hits = np.empty(total, dtype=np.uint32)
     limits = np.empty(total, dtype=np.uint32)
-    fresh = np.empty(total, dtype=bool)
     shadow = np.empty(total, dtype=bool)
 
     try:
-        table = engine.slot_table
-        table.begin_batch()
-        try:
-            j = 0
-            for it in items:
-                for lane in it.lanes:
-                    slots[j], fresh[j] = engine.assign_slot(
-                        lane.key, it.now, lane.expiry
-                    )
-                    hits[j] = min(lane.hits, 0xFFFFFFFF)
-                    limits[j] = lane.limit
-                    shadow[j] = lane.shadow
-                    j += 1
-        finally:
-            table.end_batch()
+        j = 0
+        # `now` only drives gc/eviction; items in one batch differ by
+        # at most the batch window.
+        now = max(it.now for it in items)
+        for it in items:
+            for lane in it.lanes:
+                keys.append(lane.key)
+                expiries.append(lane.expiry)
+                hits[j] = min(lane.hits, 0xFFFFFFFF)
+                limits[j] = lane.limit
+                shadow[j] = lane.shadow
+                j += 1
+        # One call assigns (and pins) every key in the combined batch —
+        # a single FFI round trip on the native table.
+        slots64, fresh = engine.slot_table.assign_batch(keys, now, expiries)
+        slots = slots64.astype(np.int32)
 
         decisions = engine.step(HostBatch(slots, hits, limits, fresh, shadow))
     except BaseException as e:

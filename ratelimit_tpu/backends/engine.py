@@ -48,6 +48,22 @@ class HostDecisions:
     set_local_cache: np.ndarray
 
 
+def _pick_table_cls(native: Optional[bool]):
+    """Slot-table implementation choice: C++ (one FFI call per batch)
+    with automatic fallback to the Python oracle."""
+    from .slot_table import SlotTable
+
+    if native is False:
+        return SlotTable
+    from . import native_slot_table
+
+    if native_slot_table.available():
+        return native_slot_table.NativeSlotTable
+    if native is True:
+        raise RuntimeError("native slot table requested but unavailable")
+    return SlotTable
+
+
 def _decide_host(
     afters_padded: np.ndarray,
     batch: "HostBatch",
@@ -92,18 +108,19 @@ class CounterEngine:
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         device: Optional[jax.Device] = None,
         model=None,
+        native_table: Optional[bool] = None,
     ):
         """`model` defaults to a single-chip FixedWindowModel; pass any
         object with the same surface (init_state/step_counters/
         num_slots/near_ratio) — e.g. parallel.ShardedFixedWindowModel —
         to run the same host orchestration over a different device
-        layout."""
-        from .slot_table import SlotTable
-
+        layout.  `native_table`: None = use the C++ slot table when it
+        builds/loads, True = require it, False = pure Python."""
         self.model = model if model is not None else FixedWindowModel(
             num_slots, near_ratio
         )
-        self.slot_table = SlotTable(self.model.num_slots)
+        self._table_cls = _pick_table_cls(native_table)
+        self.slot_table = self._table_cls(self.model.num_slots)
         self.buckets = tuple(sorted(buckets))
         self.max_batch = self.buckets[-1]
         self._device = device
@@ -199,13 +216,11 @@ class CounterEngine:
 
     def reset(self) -> None:
         """Drop all counters and key assignments (tests)."""
-        from .slot_table import SlotTable
-
         counts = self.model.init_state()
         if self._device is not None:
             counts = jax.device_put(counts, self._device)
         self._counts = counts
-        self.slot_table = SlotTable(self.model.num_slots)
+        self.slot_table = self._table_cls(self.model.num_slots)
 
     # -- checkpoint surface (backends/checkpoint.py) --------------------
 

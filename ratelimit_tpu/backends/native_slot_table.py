@@ -1,0 +1,235 @@
+"""ctypes binding for the C++ slot table (native/slot_table.cpp).
+
+Same contract as the Python SlotTable (backends/slot_table.py, which
+stays as the behavioral oracle and automatic fallback); the native
+version assigns a whole batch per call — keys cross the FFI boundary
+once as a length-prefixed utf-8 blob — so the per-descriptor
+interpreter cost leaves the dispatcher thread.
+
+The shared library is built on demand with g++ (one-time, cached next
+to the package); if no compiler or build failure, callers fall back to
+the Python table.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("ratelimit.native")
+
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_LOCK = threading.Lock()
+_LIB_FAILED = False
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "slot_table.cpp",
+)
+_SO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_libslottable.so")
+
+
+def _build() -> bool:
+    if not os.path.exists(_SRC):
+        return False
+    # Build to a temp path + atomic rename: concurrent processes never
+    # dlopen a half-written .so, and a rebuild never truncates a file
+    # another running process has mapped (the old inode survives).
+    tmp = f"{_SO}.tmp.{os.getpid()}"
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(tmp, _SO)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.warning("native slot table build failed (%s); using Python", e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _signatures(lib: ctypes.CDLL) -> None:
+    i64, u8p, i64p = ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64)
+    lib.sk_create.restype = ctypes.c_void_p
+    lib.sk_create.argtypes = [i64]
+    lib.sk_destroy.argtypes = [ctypes.c_void_p]
+    lib.sk_len.restype = i64
+    lib.sk_len.argtypes = [ctypes.c_void_p]
+    lib.sk_evictions.restype = i64
+    lib.sk_evictions.argtypes = [ctypes.c_void_p]
+    lib.sk_gc.restype = i64
+    lib.sk_gc.argtypes = [ctypes.c_void_p, i64]
+    lib.sk_begin_batch.argtypes = [ctypes.c_void_p]
+    lib.sk_end_batch.argtypes = [ctypes.c_void_p]
+    lib.sk_assign_batch.restype = i64
+    lib.sk_assign_batch.argtypes = [
+        ctypes.c_void_p, u8p, i64p, i64, i64, i64p, i64p, u8p,
+    ]
+    lib.sk_export_size.restype = i64
+    lib.sk_export_size.argtypes = [ctypes.c_void_p, i64p]
+    lib.sk_export.argtypes = [ctypes.c_void_p, u8p, i64p, i64p, i64p]
+    lib.sk_import.restype = i64
+    lib.sk_import.argtypes = [ctypes.c_void_p, u8p, i64p, i64p, i64p, i64]
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _LIB_FAILED
+    if _LIB is not None or _LIB_FAILED:
+        return _LIB
+    with _LIB_LOCK:
+        if _LIB is not None or _LIB_FAILED:
+            return _LIB
+        if not os.path.exists(_SO) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+        ):
+            if not _build():
+                _LIB_FAILED = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            _signatures(lib)
+            _LIB = lib
+        except OSError as e:
+            logger.warning("native slot table load failed (%s); using Python", e)
+            _LIB_FAILED = True
+    return _LIB
+
+
+def available() -> bool:
+    return _get_lib() is not None
+
+
+def _pack_keys(keys: List[str]) -> Tuple[np.ndarray, np.ndarray]:
+    encoded = [k.encode("utf-8") for k in keys]
+    lens = np.fromiter((len(b) for b in encoded), dtype=np.int64, count=len(encoded))
+    blob = np.frombuffer(b"".join(encoded), dtype=np.uint8)
+    return blob, lens
+
+
+def _i64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _u8p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+class NativeSlotTable:
+    """Drop-in for backends.slot_table.SlotTable backed by C++."""
+
+    def __init__(self, num_slots: int):
+        lib = _get_lib()
+        if lib is None:
+            raise RuntimeError("native slot table library unavailable")
+        self._lib = lib
+        self.num_slots = int(num_slots)
+        self._handle = lib.sk_create(self.num_slots)
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.sk_destroy(handle)
+            self._handle = None
+
+    def __len__(self) -> int:
+        return int(self._lib.sk_len(self._handle))
+
+    @property
+    def evictions(self) -> int:
+        return int(self._lib.sk_evictions(self._handle))
+
+    def gc(self, now: int) -> int:
+        return int(self._lib.sk_gc(self._handle, int(now)))
+
+    def begin_batch(self) -> None:
+        """Start cross-call pinning (same protocol as the Python
+        table): every key touched until end_batch cannot be evicted."""
+        self._lib.sk_begin_batch(self._handle)
+
+    def end_batch(self) -> None:
+        self._lib.sk_end_batch(self._handle)
+
+    def assign_batch(
+        self, keys: List[str], now: int, expiries: List[int]
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Assign every key in one FFI call; returns (slots, fresh)."""
+        n = len(keys)
+        if n == 0:
+            return np.zeros(0, np.int64), np.zeros(0, bool)
+        blob, lens = _pack_keys(keys)
+        exp = np.asarray(expiries, dtype=np.int64)
+        out_slots = np.empty(n, dtype=np.int64)
+        out_fresh = np.empty(n, dtype=np.uint8)
+        rc = self._lib.sk_assign_batch(
+            self._handle,
+            _u8p(blob),
+            _i64p(lens),
+            n,
+            int(now),
+            _i64p(exp),
+            _i64p(out_slots),
+            _u8p(out_fresh),
+        )
+        if rc != 0:
+            raise RuntimeError(
+                "slot table exhausted: batch holds more live keys than "
+                f"slots ({self.num_slots}); raise TPU_NUM_SLOTS above the "
+                "max batch size"
+            )
+        return out_slots, out_fresh.astype(bool)
+
+    def assign(self, key: str, now: int, expiry: int) -> Tuple[int, bool]:
+        slots, fresh = self.assign_batch([key], now, [expiry])
+        return int(slots[0]), bool(fresh[0])
+
+    # -- checkpoint surface ---------------------------------------------
+
+    def entries(self) -> List[Tuple[str, int, int]]:
+        total_bytes = ctypes.c_int64(0)
+        n = int(self._lib.sk_export_size(self._handle, ctypes.byref(total_bytes)))
+        if n == 0:
+            return []
+        blob = np.empty(total_bytes.value, dtype=np.uint8)
+        lens = np.empty(n, dtype=np.int64)
+        slots = np.empty(n, dtype=np.int64)
+        expiries = np.empty(n, dtype=np.int64)
+        self._lib.sk_export(
+            self._handle, _u8p(blob), _i64p(lens), _i64p(slots), _i64p(expiries)
+        )
+        out = []
+        raw = blob.tobytes()
+        off = 0
+        for i in range(n):
+            ln = int(lens[i])
+            out.append(
+                (raw[off : off + ln].decode("utf-8"), int(slots[i]), int(expiries[i]))
+            )
+            off += ln
+        return out
+
+    @classmethod
+    def from_entries(cls, num_slots: int, entries) -> "NativeSlotTable":
+        t = cls(num_slots)
+        if entries:
+            keys = [e[0] for e in entries]
+            blob, lens = _pack_keys(keys)
+            slots = np.asarray([e[1] for e in entries], dtype=np.int64)
+            exp = np.asarray([e[2] for e in entries], dtype=np.int64)
+            t._lib.sk_import(
+                t._handle, _u8p(blob), _i64p(lens), _i64p(slots), _i64p(exp), len(keys)
+            )
+        return t

@@ -44,6 +44,11 @@ class SlotTable:
         """
         entry = self._map.get(key)
         if entry is not None:
+            # Pin existing keys too: a slot already handed out in this
+            # batch must not be evicted for a later lane (it would
+            # alias two live keys inside one device step).
+            if self._batch_active:
+                self._pinned.add(key)
             return entry[0], False
 
         if not self._free:
@@ -68,6 +73,22 @@ class SlotTable:
     def end_batch(self) -> None:
         self._batch_active = False
         self._pinned.clear()
+
+    def assign_batch(self, keys, now: int, expiries):
+        """Assign every key (pinned together); returns (slots, fresh)
+        numpy arrays.  Same surface as NativeSlotTable.assign_batch."""
+        import numpy as np
+
+        n = len(keys)
+        slots = np.empty(n, dtype=np.int64)
+        fresh = np.empty(n, dtype=bool)
+        self.begin_batch()
+        try:
+            for j, (key, expiry) in enumerate(zip(keys, expiries)):
+                slots[j], fresh[j] = self.assign(key, now, expiry)
+        finally:
+            self.end_batch()
+        return slots, fresh
 
     def entries(self) -> List[Tuple[str, int, int]]:
         """Live (key, slot, expiry) triples (checkpoint export)."""
