@@ -1,0 +1,104 @@
+"""Pallas TPU kernel for the per-slot inclusive prefix.
+
+The XLA path (ops/prefix.py) sorts the batch to group equal slots —
+on TPU that lowers to a bitonic sort network plus scatter-unsort.
+This kernel computes the same thing sort-free as a tiled mask
+reduction on the VPU:
+
+    incl[i] = sum_j hits[j] * (slots[j] == slots[i]) * (j <= i)
+
+For a row tile of T lanes it materializes a (T, N) equality*causality
+mask in VMEM and reduces it against the hits row — O(N^2/T) perfectly
+vectorized int32 work with zero data-dependent control flow, instead
+of a sort's O(N log^2 N) with heavy constants.
+
+int32 accumulation is exact while sum(hits over one slot) < 2^31
+(4096 lanes * 65535 max hits < 2^28).
+
+MEASURED (TPU v5e-1, batch 4096, 2025): the sort-based XLA path runs
+at 0.9us/step inside a scan; this kernel at 537us/step (16.7M masked
+int ops are real work; a 4096-lane sort is nearly free for XLA).  The
+sort path therefore REMAINS THE DEFAULT — this kernel is kept as a
+validated custom-kernel alternative (bit-identical outputs on TPU,
+locked by tests in interpreter mode) and as the template for future
+pallas work where XLA's lowering actually loses.
+
+On non-TPU backends the kernel runs in interpreter mode (tests).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# 256x4096 int32 mask tile = 4 MiB of VMEM.
+ROW_TILE = 256
+
+
+def _prefix_kernel(slots_tile_ref, slots_ref, hits_ref, out_ref):
+    t = pl.program_id(0)
+    row_slots = slots_tile_ref[0, :]  # (T,)
+    all_slots = slots_ref[0, :]  # (N,)
+    hits = hits_ref[0, :].astype(jnp.int32)  # (N,)
+
+    T = row_slots.shape[0]
+    N = all_slots.shape[0]
+    j_idx = jax.lax.broadcasted_iota(jnp.int32, (T, N), 1)
+    i_global = t * T + jax.lax.broadcasted_iota(jnp.int32, (T, N), 0)
+
+    mask = (row_slots[:, None] == all_slots[None, :]) & (j_idx <= i_global)
+    contrib = jnp.where(mask, hits[None, :], 0)
+    out_ref[0, :] = jnp.sum(contrib, axis=1)
+
+
+def per_slot_inclusive_prefix_pallas(
+    slots: jax.Array, hits: jax.Array, interpret=None
+) -> jax.Array:
+    """Drop-in for ops.prefix.per_slot_inclusive_prefix (uint32 out).
+
+    N must be a multiple of 128 (the engine's bucket sizes are); row
+    tiling adapts to small batches.  `interpret` defaults to
+    interpreter mode everywhere except real TPU backends.
+    """
+    if interpret is None:
+        interpret = default_interpret()
+    return _prefix_pallas_jit(slots, hits, interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _prefix_pallas_jit(
+    slots: jax.Array, hits: jax.Array, interpret: bool
+) -> jax.Array:
+    n = slots.shape[0]
+    tile = min(ROW_TILE, n)
+    grid = (n + tile - 1) // tile
+
+    slots2 = slots.reshape(1, n)
+    hits2 = hits.reshape(1, n)
+    out = pl.pallas_call(
+        _prefix_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((1, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, tile), lambda i: (0, i), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((1, n), jnp.int32),
+        interpret=interpret,
+    )(slots2, slots2, hits2)
+    return out.reshape(n).astype(hits.dtype)
+
+
+def default_interpret() -> bool:
+    """Interpreter mode off only on real TPU backends."""
+    try:
+        return jax.default_backend() not in ("tpu", "axon")
+    except Exception:
+        return True

@@ -1,0 +1,163 @@
+"""Behavioral parity scenarios against the reference's semantics,
+through the full service + TPU cache stack (models:
+test/integration/integration_test.go and test/redis/fixed_cache_impl_test.go).
+"""
+
+import pytest
+
+from ratelimit_tpu.api import (
+    MAX_UINT32,
+    Code,
+    Descriptor,
+    LimitOverride,
+    RateLimitRequest,
+    Unit,
+)
+from ratelimit_tpu.backends.engine import CounterEngine
+from ratelimit_tpu.backends.tpu_cache import TpuRateLimitCache
+from ratelimit_tpu.config.loader import ConfigFile, load_config
+from ratelimit_tpu.limiter.local_cache import LocalCache
+from ratelimit_tpu.stats.manager import Manager
+
+YAML = """
+domain: p
+descriptors:
+  - key: persec
+    rate_limit:
+      unit: second
+      requests_per_unit: 2
+  - key: perminute
+    rate_limit:
+      unit: minute
+      requests_per_unit: 3
+  - key: banned
+    rate_limit:
+      unit: minute
+      requests_per_unit: 0
+"""
+
+
+@pytest.fixture
+def mgr():
+    return Manager()
+
+
+@pytest.fixture
+def cfg(mgr):
+    return load_config([ConfigFile("config.p", YAML)], mgr)
+
+
+def _limit(cfg, req):
+    return [cfg.get_limit(req.domain, d) for d in req.descriptors]
+
+
+def test_per_second_bank_routing(cfg, clock):
+    """SECOND-unit limits route to the dedicated engine bank
+    (dual-Redis analog, fixed_cache_impl.go:77-87)."""
+    main = CounterEngine(num_slots=64)
+    persec = CounterEngine(num_slots=64)
+    cache = TpuRateLimitCache(
+        main, time_source=clock, per_second_engine=persec
+    )
+    req = RateLimitRequest(
+        "p",
+        [Descriptor.of(("persec", "a")), Descriptor.of(("perminute", "a"))],
+        1,
+    )
+    st = cache.do_limit(req, _limit(cfg, req))
+    assert [s.code for s in st] == [Code.OK, Code.OK]
+    # One key landed in each bank.
+    assert len(persec.slot_table) == 1
+    assert len(main.slot_table) == 1
+
+
+def test_per_second_window_rolls(cfg, clock):
+    cache = TpuRateLimitCache(CounterEngine(num_slots=64), time_source=clock)
+    req = RateLimitRequest("p", [Descriptor.of(("persec", "a"))], 1)
+    limits = _limit(cfg, req)
+    codes = [cache.do_limit(req, limits)[0].code for _ in range(3)]
+    assert codes == [Code.OK, Code.OK, Code.OVER_LIMIT]
+    clock.now += 1  # next second = new window = new key
+    assert cache.do_limit(req, limits)[0].code == Code.OK
+
+
+def test_banned_key_always_over_limit(cfg, clock):
+    """requests_per_unit: 0 rejects the first hit (after=1 > 0)."""
+    cache = TpuRateLimitCache(CounterEngine(num_slots=64), time_source=clock)
+    req = RateLimitRequest("p", [Descriptor.of(("banned", "x"))], 1)
+    st = cache.do_limit(req, _limit(cfg, req))
+    assert st[0].code == Code.OVER_LIMIT
+    assert st[0].limit_remaining == 0
+
+
+def test_hits_addend_consumes_quota(mgr, cfg, clock):
+    """hits_addend>1: partial-hit accounting across the boundary
+    (base_limiter.go:150-179)."""
+    cache = TpuRateLimitCache(CounterEngine(num_slots=64), time_source=clock)
+    req = RateLimitRequest("p", [Descriptor.of(("perminute", "h"))], 2)
+    limits = _limit(cfg, req)
+    st1 = cache.do_limit(req, limits)  # after=2 of 3
+    assert (st1[0].code, st1[0].limit_remaining) == (Code.OK, 1)
+    st2 = cache.do_limit(req, limits)  # after=4: 1 within, 1 over
+    assert st2[0].code == Code.OVER_LIMIT
+    snap = mgr.store.counters()
+    base = "ratelimit.service.rate_limit.p.perminute"
+    assert snap[f"{base}.total_hits"] == 4
+    assert snap[f"{base}.over_limit"] == 1
+    assert snap[f"{base}.within_limit"] == 2
+    # the straddling hit attributes 1 to near_limit (3*0.8=2 threshold)
+    assert snap[f"{base}.near_limit"] == 1
+
+
+def test_request_supplied_override(cfg, clock):
+    """A descriptor-embedded limit bypasses the configured trie
+    (config_impl.go:254-265) and uses dotted stat keys."""
+    cache = TpuRateLimitCache(CounterEngine(num_slots=64), time_source=clock)
+    desc = Descriptor.of(
+        ("perminute", "o"), limit=LimitOverride(1, Unit.HOUR)
+    )
+    req = RateLimitRequest("p", [desc], 1)
+    limits = _limit(cfg, req)
+    assert limits[0].limit.requests_per_unit == 1
+    assert limits[0].limit.unit == Unit.HOUR
+    codes = [cache.do_limit(req, limits)[0].code for _ in range(2)]
+    assert codes == [Code.OK, Code.OVER_LIMIT]
+
+
+def test_local_cache_short_circuits_engine(mgr, cfg, clock):
+    """After the first over-limit, the host cache answers without
+    touching the engine until the window rolls
+    (base_limiter.go:63-72,103-115)."""
+    engine = CounterEngine(num_slots=64)
+    cache = TpuRateLimitCache(
+        engine, time_source=clock, local_cache=LocalCache(1 << 16)
+    )
+    req = RateLimitRequest("p", [Descriptor.of(("perminute", "lc"))], 1)
+    limits = _limit(cfg, req)
+    for _ in range(4):
+        cache.do_limit(req, limits)
+
+    steps_before = engine.slot_table.evictions  # capture engine state
+    n_table = len(engine.slot_table)
+    st = cache.do_limit(req, limits)
+    assert st[0].code == Code.OVER_LIMIT
+    snap = mgr.store.counters()
+    base = "ratelimit.service.rate_limit.p.perminute"
+    assert snap[f"{base}.over_limit_with_local_cache"] >= 1
+    assert len(engine.slot_table) == n_table  # engine untouched
+    assert engine.slot_table.evictions == steps_before
+
+    # Window rolls: key changes, cache entry irrelevant, engine serves.
+    clock.now += 60
+    st = cache.do_limit(req, limits)
+    assert st[0].code == Code.OK
+
+
+def test_duration_until_reset_decays(cfg, clock):
+    cache = TpuRateLimitCache(CounterEngine(num_slots=64), time_source=clock)
+    req = RateLimitRequest("p", [Descriptor.of(("perminute", "r"))], 1)
+    limits = _limit(cfg, req)
+    clock.now = 1200  # window start
+    assert cache.do_limit(req, limits)[0].duration_until_reset == 60
+    clock.now = 1247
+    assert cache.do_limit(req, limits)[0].duration_until_reset == 13

@@ -1,0 +1,84 @@
+"""SRV resolver against a fake in-process DNS server (the reference
+tests srv.go with a mocked net.LookupSRV; we go one layer lower and
+serve real DNS wire format over a loopback UDP socket)."""
+
+import socket
+import struct
+import threading
+
+import pytest
+
+from ratelimit_tpu.utils.srv import (
+    SrvError,
+    parse_srv,
+    server_strings_from_srv,
+)
+
+
+def test_parse_srv():
+    assert parse_srv("_memcache._tcp.mycompany.com") == (
+        "memcache",
+        "tcp",
+        "mycompany.com",
+    )
+    for bad in ("memcache.tcp.x", "_memcache.tcp.x", "_m._t", ""):
+        with pytest.raises(SrvError):
+            parse_srv(bad)
+
+
+def _encode_name(name):
+    out = b""
+    for label in name.rstrip(".").split("."):
+        out += bytes([len(label)]) + label.encode()
+    return out + b"\x00"
+
+
+class FakeDns(threading.Thread):
+    """One-shot DNS server answering any SRV query with two records."""
+
+    def __init__(self, answers):
+        super().__init__(daemon=True)
+        self.answers = answers
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind(("127.0.0.1", 0))
+        self.addr = self.sock.getsockname()
+
+    def run(self):
+        data, client = self.sock.recvfrom(4096)
+        txid = data[:2]
+        question = data[12:]
+        resp = txid + struct.pack(
+            "!HHHHH", 0x8180, 1, len(self.answers), 0, 0
+        )
+        resp += question  # echo the question section
+        for prio, weight, port, target in self.answers:
+            rdata = struct.pack("!HHH", prio, weight, port) + _encode_name(target)
+            resp += (
+                b"\xc0\x0c"  # pointer to qname
+                + struct.pack("!HHIH", 33, 1, 60, len(rdata))
+                + rdata
+            )
+        self.sock.sendto(resp, client)
+        self.sock.close()
+
+
+def test_lookup_and_ordering():
+    srv = FakeDns(
+        [
+            (20, 0, 11212, "backup.example.com"),
+            (10, 5, 11211, "cache1.example.com"),
+        ]
+    )
+    srv.start()
+    out = server_strings_from_srv(
+        "_memcache._tcp.example.com", resolver=srv.addr
+    )
+    # priority 10 before 20 (srv.go ordering contract).
+    assert out == ["cache1.example.com:11211", "backup.example.com:11212"]
+
+
+def test_no_answers_is_error():
+    srv = FakeDns([])
+    srv.start()
+    with pytest.raises(SrvError):
+        server_strings_from_srv("_x._tcp.example.com", resolver=srv.addr)
