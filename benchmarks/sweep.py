@@ -1,0 +1,118 @@
+"""End-to-end serving sweep: batch window x batch limit.
+
+Mirrors the reference's (disabled) BenchmarkParallelDoLimit
+(reference test/redis/bench_test.go:22-97: parallel DoLimit against a
+local Redis over a pipeline window {0,35,75,150,300}us x limit
+{1..16} sweep, pool = GOMAXPROCS^2) — here the sweep drives the full
+TpuRateLimitCache (keygen, dispatcher micro-batching, device step,
+host decisions) from a thread pool and reports decisions/sec plus
+request-latency percentiles per configuration.
+
+    python benchmarks/sweep.py [--threads 16] [--requests 2000] \
+        [--descriptors 4] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+WINDOWS_US = (0, 35, 75, 150, 300)
+BATCH_LIMITS = (256, 1024, 4096)
+
+
+def run_config(window_us, batch_limit, threads, requests, descriptors):
+    import jax  # noqa: F401  (device selection happens at import)
+
+    from ratelimit_tpu.api import Descriptor, RateLimitRequest
+    from ratelimit_tpu.backends.engine import CounterEngine
+    from ratelimit_tpu.backends.tpu_cache import TpuRateLimitCache
+    from ratelimit_tpu.config.loader import ConfigFile, load_config
+    from ratelimit_tpu.stats.manager import Manager
+
+    yaml_text = (
+        "domain: bench\n"
+        "descriptors:\n"
+        "  - key: k\n"
+        "    rate_limit:\n"
+        "      unit: hour\n"
+        "      requests_per_unit: 1000000\n"
+    )
+    mgr = Manager()
+    cfg = load_config([ConfigFile("config.bench", yaml_text)], mgr)
+    cache = TpuRateLimitCache(
+        CounterEngine(num_slots=1 << 18),
+        batch_window_us=window_us,
+        batch_limit=batch_limit,
+    )
+    try:
+        cache.warmup()
+        rule_req = RateLimitRequest("bench", [Descriptor.of(("k", "w"))], 1)
+        rule = cfg.get_limit("bench", rule_req.descriptors[0])
+
+        reqs = []
+        for i in range(requests):
+            descs = [
+                Descriptor.of(("k", f"v{(i * descriptors + j) % 997}"))
+                for j in range(descriptors)
+            ]
+            reqs.append(RateLimitRequest("bench", descs, 1))
+        rules = [rule] * descriptors
+
+        latencies = np.zeros(requests)
+
+        def worker(i):
+            t0 = time.perf_counter()
+            cache.do_limit(reqs[i], rules)
+            latencies[i] = time.perf_counter() - t0
+
+        with ThreadPoolExecutor(max_workers=threads) as pool:
+            start = time.perf_counter()
+            list(pool.map(worker, range(requests)))
+            elapsed = time.perf_counter() - start
+
+        return {
+            "window_us": window_us,
+            "batch_limit": batch_limit,
+            "decisions_per_sec": round(requests * descriptors / elapsed, 1),
+            "p50_ms": round(float(np.percentile(latencies, 50)) * 1e3, 3),
+            "p99_ms": round(float(np.percentile(latencies, 99)) * 1e3, 3),
+        }
+    finally:
+        cache.close()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--threads", type=int, default=16)
+    p.add_argument("--requests", type=int, default=2000)
+    p.add_argument("--descriptors", type=int, default=4)
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    rows = []
+    for window in WINDOWS_US:
+        for limit in BATCH_LIMITS:
+            row = run_config(
+                window, limit, args.threads, args.requests, args.descriptors
+            )
+            rows.append(row)
+            if not args.json:
+                print(
+                    f"window={row['window_us']:>4}us limit={row['batch_limit']:>5} "
+                    f"-> {row['decisions_per_sec']:>12,.0f} dec/s  "
+                    f"p50={row['p50_ms']:7.3f}ms p99={row['p99_ms']:7.3f}ms",
+                    flush=True,
+                )
+    if args.json:
+        print(json.dumps(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -94,17 +94,19 @@ def _slice(d: HostDecisions, lo: int, hi: int) -> HostDecisions:
     )
 
 
-def run_items(engine, items: List[WorkItem]) -> None:
-    """Assemble one engine batch from `items`, step, scatter results.
+def submit_items(engine, items: List[WorkItem]):
+    """Assemble one engine batch from `items` and LAUNCH it (no wait).
 
     Must be called from the single thread that owns `engine`'s
-    SlotTable (the dispatcher thread, or the caller in inline mode).
+    SlotTable.  Returns the engine token for complete_items, or None
+    if the batch failed (items are already errored+signalled) or was
+    empty (items signalled).
     """
     total = sum(len(it.lanes) for it in items)
     if total == 0:
         for it in items:
             it.event.set()
-        return
+        return None
     keys: List[str] = []
     expiries: List[int] = []
     hits = np.empty(total, dtype=np.uint32)
@@ -129,13 +131,26 @@ def run_items(engine, items: List[WorkItem]) -> None:
         slots64, fresh = engine.slot_table.assign_batch(keys, now, expiries)
         slots = slots64.astype(np.int32)
 
-        decisions = engine.step(HostBatch(slots, hits, limits, fresh, shadow))
+        return engine.step_submit(HostBatch(slots, hits, limits, fresh, shadow))
+    except BaseException as e:
+        for it in items:
+            it.error = e
+            it.event.set()
+        return None
+
+
+def complete_items(engine, items: List[WorkItem], token) -> None:
+    """Wait for a submit_items launch, scatter decisions, signal
+    waiters.  Thread-agnostic (touches no engine state)."""
+    if token is None:
+        return  # submit already failed or was empty
+    try:
+        decisions = engine.step_complete(token)
     except BaseException as e:
         for it in items:
             it.error = e
             it.event.set()
         return
-
     off = 0
     for it in items:
         n = len(it.lanes)
@@ -147,8 +162,24 @@ def run_items(engine, items: List[WorkItem]) -> None:
         it.event.set()
 
 
+def run_items(engine, items: List[WorkItem]) -> None:
+    """Synchronous submit+complete (inline mode, tests)."""
+    complete_items(engine, items, submit_items(engine, items))
+
+
 class BatchDispatcher:
-    """Single background thread batching WorkItems for one engine."""
+    """Two-stage pipelined dispatcher for one engine.
+
+    The COLLECTOR thread owns the slot table and the device queue: it
+    accumulates WorkItems (window/limit), assigns slots, and LAUNCHES
+    the device step without waiting.  The COMPLETER thread waits on
+    each launch's readback in order and answers the waiting RPCs.  Up
+    to `pipeline_depth` launches are in flight, so the device->host
+    transfer of batch N overlaps the collection+launch of batch N+1 —
+    on a high-RTT link this multiplies request-response throughput by
+    the pipeline depth (the counts donation chain keeps the compute
+    order correct on device regardless).
+    """
 
     def __init__(
         self,
@@ -156,13 +187,25 @@ class BatchDispatcher:
         batch_window_us: int = 200,
         batch_limit: int = 4096,
         name: str = "tpu-dispatcher",
+        pipeline_depth: int = 2,
     ):
         self.engine = engine
         self.window_s = batch_window_us / 1e6
         self.batch_limit = int(batch_limit)
         self._q: "queue.Queue" = queue.Queue()
-        self._thread = threading.Thread(target=self._loop, name=name, daemon=True)
+        # Bounded: backpressure keeps at most pipeline_depth launches
+        # in flight ahead of the completer.
+        self._completion_q: "queue.Queue" = queue.Queue(
+            maxsize=max(1, int(pipeline_depth))
+        )
+        self._thread = threading.Thread(
+            target=self._collect_loop, name=name, daemon=True
+        )
+        self._completer = threading.Thread(
+            target=self._complete_loop, name=name + "-complete", daemon=True
+        )
         self._thread.start()
+        self._completer.start()
 
     def submit(self, item: WorkItem) -> None:
         self._q.put(item)
@@ -187,6 +230,7 @@ class BatchDispatcher:
     def stop(self) -> None:
         self._q.put(_STOP)
         self._thread.join(timeout=10)
+        self._completer.join(timeout=10)
 
     # -- internals -------------------------------------------------------
 
@@ -220,28 +264,53 @@ class BatchDispatcher:
                 break
         return batch, tokens, stopping
 
-    def _loop(self) -> None:
+    def _launch(self, batch: List[WorkItem]) -> None:
+        """Launch on the collector thread, hand to the completer."""
+        token = submit_items(self.engine, batch)
+        if token is not None:
+            self._completion_q.put(("batch", batch, token))
+
+    def _collect_loop(self) -> None:
         while True:
             batch, tokens, stopping = self._collect()
             if batch:
-                run_items(self.engine, batch)
+                self._launch(batch)
             for t in tokens:
-                self._complete_token(t)
+                if isinstance(t, _CallToken):
+                    # Calls (checkpoints) run HERE — the collector owns
+                    # the slot table, and engine counts reflect every
+                    # launch so far (donation chain), so the snapshot
+                    # is consistent without waiting for completions.
+                    self._run_call(t)
+                else:
+                    # Flushes wait for COMPLETION of everything before
+                    # them: route through the completer.
+                    self._completion_q.put(("token", t, None))
             if stopping:
                 self._drain()
+                self._completion_q.put(("stop", None, None))
                 return
 
+    def _complete_loop(self) -> None:
+        while True:
+            kind, payload, token = self._completion_q.get()
+            if kind == "stop":
+                return
+            if kind == "token":
+                payload.event.set()
+            else:
+                complete_items(self.engine, payload, token)
+
     @staticmethod
-    def _complete_token(t) -> None:
-        if isinstance(t, _CallToken):
-            try:
-                t.fn()
-            except BaseException as e:
-                t.error = e
+    def _run_call(t: "_CallToken") -> None:
+        try:
+            t.fn()
+        except BaseException as e:
+            t.error = e
         t.event.set()
 
     def _drain(self) -> None:
-        """Complete everything still queued at stop time so no waiter
+        """Launch everything still queued at stop time so no waiter
         hangs (items racing stop() land behind the _STOP sentinel)."""
         leftovers: List[WorkItem] = []
         while True:
@@ -251,10 +320,15 @@ class BatchDispatcher:
                 break
             if isinstance(obj, WorkItem):
                 leftovers.append(obj)
-            elif isinstance(obj, (_FlushToken, _CallToken)):
+            elif isinstance(obj, _CallToken):
                 if leftovers:
-                    run_items(self.engine, leftovers)
+                    self._launch(leftovers)
                     leftovers = []
-                self._complete_token(obj)
+                self._run_call(obj)
+            elif isinstance(obj, _FlushToken):
+                if leftovers:
+                    self._launch(leftovers)
+                    leftovers = []
+                self._completion_q.put(("token", obj, None))
         if leftovers:
-            run_items(self.engine, leftovers)
+            self._launch(leftovers)

@@ -128,6 +128,12 @@ class CounterEngine:
         if device is not None:
             counts = jax.device_put(counts, device)
         self._counts = counts
+        # Gauge snapshot, updated only by the thread that owns the slot
+        # table (step_submit); read lock-free from stats/HTTP threads
+        # (plain int attribute reads are atomic under the GIL), so
+        # observers never call into the un-synchronized native table.
+        self.stat_live_keys = 0
+        self.stat_evictions = 0
 
     # -- host-side key handling -----------------------------------------
 
@@ -147,13 +153,41 @@ class CounterEngine:
 
     def step(self, batch: HostBatch) -> HostDecisions:
         """Run one padded device step per <=max_batch chunk."""
+        return self.step_complete(self.step_submit(batch))
+
+    def step_submit(self, batch: HostBatch):
+        """Launch the device work for `batch` WITHOUT waiting for the
+        readback; returns an opaque token for step_complete.
+
+        Split so the dispatcher can pipeline: launch batch N+1 while
+        batch N's device->host transfer is still in flight (the counts
+        donation chain serializes the compute correctly on device).
+        Must be called from the thread that owns this engine.
+        """
         n = len(batch.slots)
-        if n == 0:
+        chunks = []
+        for start in range(0, n, self.max_batch):
+            count = min(n - start, self.max_batch)
+            chunks.append((self._submit_chunk(batch, start, count), start, count))
+        self.stat_live_keys = len(self.slot_table)
+        self.stat_evictions = self.slot_table.evictions
+        return (batch, chunks)
+
+    def step_complete(self, token) -> HostDecisions:
+        """Block on the readback for a step_submit token and run the
+        host threshold state machine.  Thread-agnostic (touches no
+        engine state)."""
+        batch, chunks = token
+        if not chunks:
             empty = np.zeros(0, dtype=np.int32)
             return HostDecisions(*([empty] * 8), empty.astype(bool))
-        outs: List[HostDecisions] = []
-        for start in range(0, n, self.max_batch):
-            outs.append(self._step_chunk(batch, start, min(n - start, self.max_batch)))
+        outs: List[HostDecisions] = [
+            _decide_host(
+                jax.device_get(afters_dev), batch, start, count,
+                self.model.near_ratio,
+            )
+            for afters_dev, start, count in chunks
+        ]
         if len(outs) == 1:
             return outs[0]
         return HostDecisions(
@@ -163,7 +197,7 @@ class CounterEngine:
             )
         )
 
-    def _step_chunk(self, batch: HostBatch, start: int, count: int) -> HostDecisions:
+    def _submit_chunk(self, batch: HostBatch, start: int, count: int):
         padded = self._bucket(count)
         sl = np.full(padded, self.model.num_slots, dtype=np.int32)
         hi = np.zeros(padded, dtype=np.uint32)
@@ -206,13 +240,7 @@ class CounterEngine:
             self._counts, afters_dev = self.model.step_counters(
                 self._counts, device_batch
             )
-        return _decide_host(
-            jax.device_get(afters_dev),
-            batch,
-            start,
-            count,
-            self.model.near_ratio,
-        )
+        return afters_dev
 
     def reset(self) -> None:
         """Drop all counters and key assignments (tests)."""

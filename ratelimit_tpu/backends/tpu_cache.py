@@ -66,6 +66,7 @@ class TpuRateLimitCache:
         batch_window_us: int = 0,
         batch_limit: int = 4096,
         dispatch_timeout_s: float = 120.0,
+        pipeline_depth: int = 2,
     ):
         self.engine = engine
         self.per_second_engine = per_second_engine
@@ -94,7 +95,11 @@ class TpuRateLimitCache:
         self._dispatchers: dict = {}
         if batch_window_us > 0:
             self._dispatchers[id(engine)] = BatchDispatcher(
-                engine, batch_window_us, batch_limit, name="tpu-dispatcher"
+                engine,
+                batch_window_us,
+                batch_limit,
+                name="tpu-dispatcher",
+                pipeline_depth=pipeline_depth,
             )
             if per_second_engine is not None:
                 self._dispatchers[id(per_second_engine)] = BatchDispatcher(
@@ -102,6 +107,7 @@ class TpuRateLimitCache:
                     batch_window_us,
                     batch_limit,
                     name="tpu-dispatcher-persecond",
+                    pipeline_depth=pipeline_depth,
                 )
 
     # -- RateLimitCache seam --------------------------------------------
@@ -217,6 +223,28 @@ class TpuRateLimitCache:
         dispatchers, self._dispatchers = list(self._dispatchers.values()), {}
         for d in dispatchers:
             d.stop()
+
+    def register_stats(self, store, scope: str = "ratelimit.tpu") -> None:
+        """Live gauges for each bank (slot-table occupancy/evictions,
+        dispatcher queue depth) — the analog of the reference's redis
+        pool gauges (driver_impl.go:17-29)."""
+        for idx, engine in enumerate(self.engines()):
+            base = f"{scope}.bank{idx}"
+            # Cached snapshots updated by the table-owning thread —
+            # never call into the (unsynchronized) native table from
+            # observer threads.
+            store.gauge_fn(base + ".live_keys", lambda e=engine: e.stat_live_keys)
+            store.gauge_fn(
+                base + ".evictions", lambda e=engine: e.stat_evictions
+            )
+            store.gauge_fn(
+                base + ".num_slots", lambda e=engine: e.model.num_slots
+            )
+            d = self._dispatchers.get(id(engine))
+            if d is not None:
+                store.gauge_fn(
+                    base + ".dispatch_queue", lambda dd=d: dd._q.qsize()
+                )
 
     def engines(self):
         """All live counter banks, main first (checkpoint surface)."""

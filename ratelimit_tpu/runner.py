@@ -100,6 +100,7 @@ def create_limiter(s: Settings, stats_manager: Manager, local_cache, time_source
             batch_window_us=s.tpu_batch_window_us,
             batch_limit=s.tpu_batch_limit,
             dispatch_timeout_s=s.tpu_dispatch_timeout_s,
+            pipeline_depth=s.tpu_pipeline_depth,
         )
     raise ValueError(f"Invalid setting for BackendType: {s.backend_type}")
 
@@ -152,6 +153,8 @@ class Runner:
 
         time_source = RealTimeSource()
         self.cache = create_limiter(s, self.stats_manager, local_cache, time_source)
+        if hasattr(self.cache, "register_stats"):
+            self.cache.register_stats(self.stats_manager.store)
         if s.tpu_warmup and hasattr(self.cache, "warmup"):
             logger.warning("warming up kernel shapes (TPU_WARMUP=true)...")
             self.cache.warmup()

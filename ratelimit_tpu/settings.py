@@ -141,6 +141,9 @@ class Settings:
     # default because first-batch XLA compilation can take tens of
     # seconds on large meshes (see TpuRateLimitCache.warmup).
     tpu_dispatch_timeout_s: float = 120.0
+    # Device launches in flight ahead of the completer (readback of
+    # batch N overlaps collection+launch of batch N+1).
+    tpu_pipeline_depth: int = 2
     # Pre-compile every (bucket, dtype) kernel shape at startup.
     tpu_warmup: bool = False
     # Counter-state checkpointing (closes the restart-amnesia gap the
@@ -197,6 +200,7 @@ def new_settings() -> Settings:
         tpu_batch_window_us=_env_int("TPU_BATCH_WINDOW_US", 200),
         tpu_batch_limit=_env_int("TPU_BATCH_LIMIT", 4096),
         tpu_dispatch_timeout_s=_env_float("TPU_DISPATCH_TIMEOUT_S", 120.0),
+        tpu_pipeline_depth=_env_int("TPU_PIPELINE_DEPTH", 2),
         tpu_warmup=_env_bool("TPU_WARMUP", False),
         tpu_checkpoint_dir=_env_str("TPU_CHECKPOINT_DIR", ""),
         tpu_checkpoint_interval_s=_env_float("TPU_CHECKPOINT_INTERVAL_S", 30.0),
