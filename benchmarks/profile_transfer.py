@@ -1,0 +1,66 @@
+"""Host<->device transfer + dispatch-latency profiling.
+
+Separates the three candidate costs of the serving step: device
+compute (profile_step.py shows it's negligible), per-dispatch launch
+latency, and device->host readback bandwidth — on whatever transport
+jax.devices() sits behind (PCIe locally; a tunnel under axon).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    print(f"devices={jax.devices()}")
+
+    # 1. Dispatch round-trip latency: tiny compute, tiny readback.
+    x = jnp.zeros((8,), dtype=jnp.uint32)
+    f = jax.jit(lambda x: x + 1)
+    jax.block_until_ready(f(x))
+    for trial in range(3):
+        t0 = time.perf_counter()
+        n = 50
+        for _ in range(n):
+            y = f(x)
+            np.asarray(y)
+        dt = (time.perf_counter() - t0) / n
+        print(f"round-trip latency (8B readback): {dt*1e6:9.1f} us")
+
+    # 2. Device->host bandwidth at increasing sizes.
+    for nbytes in (4096, 65536, 1 << 20, 8 << 20, 64 << 20):
+        a = jnp.zeros((nbytes // 4,), dtype=jnp.uint32) + 1
+        jax.block_until_ready(a)
+        np.asarray(a)  # warm
+        t0 = time.perf_counter()
+        reps = 3 if nbytes >= (8 << 20) else 10
+        for _ in range(reps):
+            np.asarray(a)
+        dt = (time.perf_counter() - t0) / reps
+        print(
+            f"D2H {nbytes/1024:10.0f} KiB: {dt*1e3:8.2f} ms  "
+            f"{nbytes/dt/1e6:10.1f} MB/s"
+        )
+
+    # 3. Host->device bandwidth.
+    for nbytes in (65536, 1 << 20, 8 << 20):
+        h = np.zeros((nbytes // 4,), dtype=np.uint32)
+        jax.block_until_ready(jax.device_put(h))  # warm
+        t0 = time.perf_counter()
+        reps = 5
+        for _ in range(reps):
+            jax.block_until_ready(jax.device_put(h))
+        dt = (time.perf_counter() - t0) / reps
+        print(
+            f"H2D {nbytes/1024:10.0f} KiB: {dt*1e3:8.2f} ms  "
+            f"{nbytes/dt/1e6:10.1f} MB/s"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""Clean tunnel-cost measurement: async dispatch vs readback.
+
+Questions answered (axon-tunneled chip):
+1. Is jit dispatch an async enqueue (cheap) or a blocking RPC?
+2. Real D2H bandwidth for FRESH device data (no host-cache hits).
+3. How deep can dispatches pipeline.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    print(f"devices={jax.devices()}")
+
+    # Fresh-data D2H: run a computation producing new bytes each time,
+    # then device_get. Measures enqueue separately from fetch.
+    for nbytes in (512, 8192, 1 << 17, 1 << 20, 4 << 20, 16 << 20):
+        n = nbytes // 4
+        x = jnp.arange(n, dtype=jnp.uint32)
+        f = jax.jit(lambda x, s: x + s)
+        jax.block_until_ready(f(x, jnp.uint32(1)))
+        reps = 4
+        t0 = time.perf_counter()
+        outs = [f(x, jnp.uint32(i)) for i in range(reps)]
+        t1 = time.perf_counter()
+        hosts = [jax.device_get(o) for o in outs]
+        t2 = time.perf_counter()
+        assert hosts[-1][1] == 1 + reps - 1
+        enq = (t1 - t0) / reps
+        fetch = (t2 - t1) / reps
+        print(
+            f"{nbytes/1024:8.1f} KiB: enqueue {enq*1e3:7.2f} ms/call, "
+            f"fetch {fetch*1e3:8.2f} ms/call ({nbytes/fetch/1e6:8.1f} MB/s)"
+        )
+
+    # Pipelining depth: 16 chained dispatches, one final fetch.
+    n = 1 << 20
+    x = jnp.arange(n, dtype=jnp.uint32)
+    g = jax.jit(lambda x: x * jnp.uint32(2) + jnp.uint32(1))
+    jax.block_until_ready(g(x))
+    t0 = time.perf_counter()
+    y = x
+    for _ in range(16):
+        y = g(y)
+    t1 = time.perf_counter()
+    out = jax.device_get(y)
+    t2 = time.perf_counter()
+    print(
+        f"16 chained dispatches: enqueue {(t1-t0)*1e3:.2f} ms total, "
+        f"final 4MiB fetch {(t2-t1)*1e3:.2f} ms"
+    )
+
+
+if __name__ == "__main__":
+    main()
