@@ -64,20 +64,95 @@ def _pick_table_cls(native: Optional[bool]):
     return SlotTable
 
 
+@dataclass
+class _Dedup:
+    """Host-side duplicate-slot aggregation for one device chunk.
+
+    The slot table hands every same-key lane the same slot; combining
+    them before the device step (group totals + per-lane exclusive
+    prefixes, Redis-pipeline order) lets the device run the unique-slot
+    fast path (models/fixed_window.py step_counters_unique) and
+    reproduces per-lane results exactly on readback.
+    """
+
+    uniq_slots: np.ndarray  # int32[g] sorted unique slots
+    inv: np.ndarray  # intp[count] lane -> group
+    totals: np.ndarray  # uint64[g] group hit totals
+    prefix: np.ndarray  # uint64[count] exclusive same-slot prefix, batch order
+    fresh: np.ndarray  # bool[g] any lane fresh
+    limit_max: np.ndarray  # uint32[g] max limit in group (saturation cap)
+
+
+def _dedup_chunk(
+    slots: np.ndarray,
+    hits: np.ndarray,
+    limits: np.ndarray,
+    fresh: np.ndarray,
+) -> _Dedup:
+    uniq, inv = np.unique(slots, return_inverse=True)
+    inv = inv.reshape(-1)
+    g = len(uniq)
+    h64 = hits.astype(np.uint64)
+    totals = np.zeros(g, dtype=np.uint64)
+    np.add.at(totals, inv, h64)
+    fresh_g = np.zeros(g, dtype=bool)
+    np.logical_or.at(fresh_g, inv, fresh)
+    limit_max = np.zeros(g, dtype=np.uint32)
+    np.maximum.at(limit_max, inv, limits)
+    if g == len(slots):  # no duplicates: identity prefixes
+        prefix = np.zeros(len(slots), dtype=np.uint64)
+    else:
+        order = np.argsort(inv, kind="stable")
+        inv_s = inv[order]
+        h_s = h64[order]
+        cs = np.cumsum(h_s) - h_s  # global exclusive prefix
+        seg_start = np.empty(len(inv_s), dtype=bool)
+        seg_start[0] = True
+        seg_start[1:] = inv_s[1:] != inv_s[:-1]
+        base = cs[seg_start]  # one per group, group-id order
+        prefix = np.empty(len(slots), dtype=np.uint64)
+        prefix[order] = cs - base[inv_s]
+    return _Dedup(
+        uniq_slots=uniq.astype(np.int32),
+        inv=inv,
+        totals=totals,
+        prefix=prefix,
+        fresh=fresh_g,
+        limit_max=limit_max,
+    )
+
+
 def _decide_host(
     afters_padded: np.ndarray,
     batch: "HostBatch",
     start: int,
     count: int,
     near_ratio: float,
+    dedup: Optional["_Dedup"] = None,
 ) -> HostDecisions:
-    """Threshold state machine on host numpy, from device `afters`."""
+    """Threshold state machine on host numpy, from device `afters`.
+
+    The device returned one (possibly saturated) `after` per UNIQUE
+    slot; per-lane values are rebuilt as
+        before_lane = (after_group - group_total) + lane_prefix
+    which is exact even under saturation: the narrow readback clamps at
+    group-max-limit + group-total, and clamping only engages when the
+    true group 'before' exceeds the group-max limit — in which case
+    every lane is in the fully-over branch, whose outputs depend only
+    on before >= limit (still true for the clamped value)."""
     from ..limiter.base import decide_batch
 
     end = start + count
-    afters = afters_padded[:count].astype(np.int64)
     hits = batch.hits[start:end].astype(np.int64)
-    befores = afters - hits
+    if dedup is None:  # afters already per-lane (general device path)
+        afters = afters_padded[:count].astype(np.int64)
+        befores = afters - hits
+    else:
+        g = len(dedup.uniq_slots)
+        afters_g = afters_padded[:g].astype(np.int64)
+        before_g = afters_g - dedup.totals.astype(np.int64)
+        befores = before_g[dedup.inv] + dedup.prefix.astype(np.int64)
+        afters = befores + hits
     d = decide_batch(
         limits=batch.limits[start:end],
         befores=befores,
@@ -168,7 +243,8 @@ class CounterEngine:
         chunks = []
         for start in range(0, n, self.max_batch):
             count = min(n - start, self.max_batch)
-            chunks.append((self._submit_chunk(batch, start, count), start, count))
+            afters_dev, dedup = self._submit_chunk(batch, start, count)
+            chunks.append((afters_dev, start, count, dedup))
         self.stat_live_keys = len(self.slot_table)
         self.stat_evictions = self.slot_table.evictions
         return (batch, chunks)
@@ -184,9 +260,9 @@ class CounterEngine:
         outs: List[HostDecisions] = [
             _decide_host(
                 jax.device_get(afters_dev), batch, start, count,
-                self.model.near_ratio,
+                self.model.near_ratio, dedup,
             )
-            for afters_dev, start, count in chunks
+            for afters_dev, start, count, dedup in chunks
         ]
         if len(outs) == 1:
             return outs[0]
@@ -198,18 +274,33 @@ class CounterEngine:
         )
 
     def _submit_chunk(self, batch: HostBatch, start: int, count: int):
-        padded = self._bucket(count)
-        sl = np.full(padded, self.model.num_slots, dtype=np.int32)
+        end = start + count
+        # Host-side duplicate-slot aggregation: same-key lanes collapse
+        # to one device lane (group total + per-lane prefixes), so the
+        # device batch always has unique slots and can take the fast
+        # path (no sort/prefix/double-scatter on device — 7.5x, see
+        # benchmarks/PERF_NOTES.md).  Results are redistributed to
+        # lanes in _decide_host.
+        dedup = _dedup_chunk(
+            batch.slots[start:end],
+            batch.hits[start:end],
+            batch.limits[start:end],
+            batch.fresh[start:end],
+        )
+        g = len(dedup.uniq_slots)
+        padded = self._bucket(g)
+        # Padding uses DISTINCT out-of-table slots (num_slots + i) so
+        # the unique_indices scatter promise holds for every lane.
+        ns = self.model.num_slots
+        sl = np.arange(ns, ns + padded, dtype=np.int64).astype(np.int32)
         hi = np.zeros(padded, dtype=np.uint32)
         li = np.ones(padded, dtype=np.uint32)
         fr = np.zeros(padded, dtype=bool)
         sh = np.zeros(padded, dtype=bool)
-        end = start + count
-        sl[:count] = batch.slots[start:end]
-        hi[:count] = batch.hits[start:end]
-        li[:count] = batch.limits[start:end]
-        fr[:count] = batch.fresh[start:end]
-        sh[:count] = batch.shadow[start:end]
+        sl[:g] = dedup.uniq_slots
+        hi[:g] = dedup.totals.astype(np.uint32)  # u32 counter domain
+        li[:g] = dedup.limit_max
+        fr[:g] = dedup.fresh
 
         device_batch = DeviceBatch(
             slots=jax.numpy.asarray(sl),
@@ -223,24 +314,34 @@ class CounterEngine:
         # reruns vectorized on host from (afters, hits, limits) —
         # bit-identical to the on-device DeviceDecisions path, which
         # tests/test_counter_model.py locks against both.  When every
-        # lane's limit+hits fits in uint8/uint16, the saturated narrow
-        # readback shrinks the device->host transfer 4x/2x (see
+        # group's limit+total fits in uint8/uint16, the saturated
+        # narrow readback shrinks the device->host transfer 4x/2x (see
         # FixedWindowModel.step_counters_compact for the exactness
         # argument).
-        cap = int(hi[:count].max(initial=0)) + int(li[:count].max(initial=1))
+        unique_ok = hasattr(self.model, "step_counters_unique")
+        cap = int(hi[:g].max(initial=0)) + int(li[:g].max(initial=1))
         if cap <= 0xFF:
-            self._counts, afters_dev = self.model.step_counters_compact(
-                self._counts, "uint8", device_batch
+            fn = (
+                self.model.step_counters_unique_compact
+                if unique_ok
+                else self.model.step_counters_compact
             )
+            self._counts, afters_dev = fn(self._counts, "uint8", device_batch)
         elif cap <= 0xFFFF:
-            self._counts, afters_dev = self.model.step_counters_compact(
-                self._counts, "uint16", device_batch
+            fn = (
+                self.model.step_counters_unique_compact
+                if unique_ok
+                else self.model.step_counters_compact
             )
+            self._counts, afters_dev = fn(self._counts, "uint16", device_batch)
         else:
-            self._counts, afters_dev = self.model.step_counters(
-                self._counts, device_batch
+            fn = (
+                self.model.step_counters_unique
+                if unique_ok
+                else self.model.step_counters
             )
-        return afters_dev
+            self._counts, afters_dev = fn(self._counts, device_batch)
+        return afters_dev, dedup
 
     def reset(self) -> None:
         """Drop all counters and key assignments (tests)."""

@@ -277,11 +277,17 @@ class TpuRateLimitCache:
                 continue
             from .engine import HostBatch
 
+            ns = engine.model.num_slots
             for bucket in engine.buckets:
                 # One probe per readback dtype (u8 / u16 / u32 caps).
+                # DISTINCT out-of-table slots so the engine's dedup
+                # pass keeps all `bucket` lanes (and therefore compiles
+                # this bucket's shape, not a collapsed one).
                 for probe_limit in (100, 60_000, 3_000_000_000):
                     batch = HostBatch(
-                        slots=np.full(bucket, engine.model.num_slots, np.int32),
+                        slots=np.arange(ns, ns + bucket, dtype=np.int64).astype(
+                            np.int32
+                        ),
                         hits=np.zeros(bucket, np.uint32),
                         limits=np.full(bucket, probe_limit, np.uint32),
                         fresh=np.zeros(bucket, bool),

@@ -133,6 +133,77 @@ class FixedWindowModel:
         sat = jnp.minimum(afters, cap)
         return counts, sat.astype(jnp.dtype(out_dtype))
 
+    @functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def step_counters_unique(
+        self, counts: jax.Array, batch: DeviceBatch
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Counter update for batches whose live slots are UNIQUE.
+
+        The serving engine dedups same-key lanes host-side (the slot
+        table walks every key anyway — see CounterEngine.step_submit),
+        which unlocks the fast device step: no sort, no in-batch
+        prefix, and one scatter-set instead of scatter-set+scatter-add.
+        Measured 37.9us vs 282.7us per 4096-lane step on v5e
+        (benchmarks/PERF_NOTES.md) — 7.5x.
+
+        Contract: every lane's slot is either distinct and in
+        [0, num_slots) or a distinct out-of-table padding id (the
+        engine pads with num_slots + lane_index).
+        """
+        return self.update_unique(counts, batch)
+
+    @functools.partial(jax.jit, static_argnums=(0, 2), donate_argnums=1)
+    def step_counters_unique_compact(
+        self, counts: jax.Array, out_dtype: str, batch: DeviceBatch
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Unique fast path + saturated narrow readback (see
+        step_counters_compact for the exactness argument; with deduped
+        groups `limits` is the group-max limit and `hits` the group
+        total, which preserves exactness for every member lane —
+        saturation only engages when before > max-limit, forcing the
+        fully-over branch for the whole group)."""
+        counts, afters = self.update_unique(counts, batch)
+        cap = batch.limits + batch.hits.astype(jnp.uint32)
+        sat = jnp.minimum(afters, cap)
+        return counts, sat.astype(jnp.dtype(out_dtype))
+
+    def update_unique(
+        self, counts: jax.Array, batch: DeviceBatch
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Unique-slot update body: row-gather 'before' from the table
+        viewed as (num_slots//128, 128) — 3.3x faster than 1-D gather
+        on TPU (benchmarks/PERF_NOTES.md) — mask fresh lanes to zero,
+        and scatter-set the new values (unique indices, no conflicts)."""
+        slots = batch.slots
+        hits = batch.hits.astype(jnp.uint32)
+
+        if self.num_slots % 128 == 0:
+            rows = slots >> 7
+            lanes = slots & 127
+            rowvals = (
+                counts.reshape(-1, 128)
+                .at[rows]
+                .get(mode="fill", fill_value=0)
+            )  # (N, 128)
+            onehot = (
+                jax.lax.broadcasted_iota(jnp.int32, rowvals.shape, 1)
+                == lanes[:, None]
+            )
+            before = jnp.sum(
+                jnp.where(onehot, rowvals, jnp.uint32(0)),
+                axis=1,
+                dtype=jnp.uint32,
+            )
+        else:  # small/test tables: plain gather
+            before = counts.at[slots].get(mode="fill", fill_value=0)
+
+        before = jnp.where(batch.fresh, jnp.uint32(0), before)
+        afters = before + hits
+        counts = counts.at[slots].set(
+            afters, mode="drop", unique_indices=True
+        )
+        return counts, afters
+
     def update(
         self, counts: jax.Array, batch: DeviceBatch
     ) -> Tuple[jax.Array, jax.Array]:
