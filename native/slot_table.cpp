@@ -52,18 +52,27 @@ struct SlotTable {
     for (int64_t s = 0; s < n; ++s) free_slots.push_back(n - 1 - s);
   }
 
-  int64_t gc(int64_t now) {
+  // Pinned keys (slots already handed out in the in-flight batch) are
+  // skipped and re-queued: reclaiming one mid-batch would alias two
+  // live keys in one device step (same rule as evict_one).
+  int64_t gc(int64_t now,
+             const std::unordered_map<std::string, bool>* pinned = nullptr) {
     int64_t freed = 0;
+    std::vector<HeapItem> skipped;
     while (!heap.empty() && heap.top().expiry <= now) {
       HeapItem item = heap.top();
       heap.pop();
       auto it = map.find(item.key);
-      if (it != map.end() && it->second.second == item.expiry) {
-        free_slots.push_back(it->second.first);
-        map.erase(it);
-        ++freed;
+      if (it == map.end() || it->second.second != item.expiry) continue;
+      if (pinned && pinned->count(item.key)) {
+        skipped.push_back(std::move(item));
+        continue;
       }
+      free_slots.push_back(it->second.first);
+      map.erase(it);
+      ++freed;
     }
+    for (auto& s : skipped) heap.push(std::move(s));
     return freed;
   }
 
@@ -106,8 +115,9 @@ int64_t sk_len(void* t) {
 
 int64_t sk_evictions(void* t) { return static_cast<SlotTable*>(t)->evictions; }
 
-int64_t sk_gc(void* t, int64_t now) {
-  return static_cast<SlotTable*>(t)->gc(now);
+int64_t sk_gc(void* tp, int64_t now) {
+  SlotTable* t = static_cast<SlotTable*>(tp);
+  return t->gc(now, t->batch_active ? &t->persistent_pins : nullptr);
 }
 
 // Assign a whole batch in one call.
@@ -139,7 +149,7 @@ int64_t sk_assign_batch(void* tp, const uint8_t* key_blob,
       pinned.emplace(std::move(key), true);
       continue;
     }
-    if (t->free_slots.empty()) t->gc(now);
+    if (t->free_slots.empty()) t->gc(now, &pinned);
     if (t->free_slots.empty() && !t->evict_one(&pinned)) return -1;
     int64_t slot = t->free_slots.back();
     t->free_slots.pop_back();
@@ -202,6 +212,9 @@ int64_t sk_import(void* tp, const uint8_t* key_blob, const int64_t* key_lens,
     p += key_lens[i];
     int64_t slot = slots[i];
     if (slot < 0 || slot >= t->num_slots || used[slot]) continue;
+    // Duplicate keys in a snapshot would leak the slot (marked used,
+    // but the map emplace would silently fail): keep the first entry.
+    if (t->map.count(key)) continue;
     used[slot] = 1;
     t->heap.push(HeapItem{expiries[i], key});
     t->map.emplace(std::move(key), std::make_pair(slot, expiries[i]));

@@ -105,6 +105,8 @@ class SlotTable:
             slot = int(slot)
             if slot < 0 or slot >= num_slots or slot in used:
                 continue  # corrupt/duplicate entry: drop, don't crash
+            if key in t._map:
+                continue  # duplicate key: keep the first entry's slot
             used.add(slot)
             t._map[key] = (slot, int(expiry))
             heapq.heappush(t._heap, (int(expiry), key))
@@ -112,15 +114,27 @@ class SlotTable:
         return t
 
     def gc(self, now: int) -> int:
-        """Reclaim slots of expired keys; returns how many were freed."""
+        """Reclaim slots of expired keys; returns how many were freed.
+
+        Keys pinned by the in-flight batch are skipped and re-queued —
+        reclaiming a slot already handed out earlier in the same batch
+        (a key expiring at the batch's `now`) would alias two live keys
+        in one device step (same rule as _evict_one)."""
         freed = 0
+        skipped = []
         while self._heap and self._heap[0][0] <= now:
             expiry, key = heapq.heappop(self._heap)
             entry = self._map.get(key)
-            if entry is not None and entry[1] == expiry:
-                del self._map[key]
-                self._free.append(entry[0])
-                freed += 1
+            if entry is None or entry[1] != expiry:
+                continue
+            if self._batch_active and key in self._pinned:
+                skipped.append((expiry, key))
+                continue
+            del self._map[key]
+            self._free.append(entry[0])
+            freed += 1
+        for item in skipped:
+            heapq.heappush(self._heap, item)
         return freed
 
     def _evict_one(self) -> None:

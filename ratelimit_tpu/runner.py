@@ -184,6 +184,12 @@ class Runner:
             header_limit=s.header_ratelimit_limit,
             header_remaining=s.header_ratelimit_remaining,
             header_reset=s.header_ratelimit_reset,
+            # Re-read env-derived settings on every config reload, like
+            # the reference's settings.NewSettings() call in its reload
+            # path (ratelimit.go:77-89) — integration tests flip
+            # SHADOW_MODE/header env vars and expect a YAML touch to
+            # pick them up.
+            settings_reloader=new_settings,
         )
         self.runtime.start()
 

@@ -104,3 +104,50 @@ def test_engine_uses_native_when_available():
     assert isinstance(engine.slot_table, native_slot_table.NativeSlotTable)
     engine_py = CounterEngine(num_slots=64, native_table=False)
     assert isinstance(engine_py.slot_table, SlotTable)
+
+
+def test_gc_respects_batch_pins():
+    """ADVICE r1 (medium): gc() during assign_batch must not reclaim a
+    slot already handed out earlier in the same batch when that lane's
+    key expires at the batch's `now` (window boundary inside one
+    dispatcher batch, zero jitter)."""
+    for table in make_pair(1):
+        # k_90's window ends exactly at now=100; k_100 then needs a
+        # slot.  gc() must skip the pinned k_90 -> exhaustion, never
+        # two lanes aliasing slot 0.
+        with pytest.raises(RuntimeError, match="slot table exhausted"):
+            table.assign_batch(["k_90", "k_100"], 100, [100, 110])
+
+    # Positive case: an UNpinned expired key is still reclaimed while
+    # the pinned expired key survives.
+    for table in make_pair(2):
+        table.assign_batch(["old"], 0, [50])  # expires long before now
+        slots, fresh = table.assign_batch(["k_90", "k_100"], 100, [100, 110])
+        assert slots[0] != slots[1]
+        assert list(fresh) == [True, True]
+        assert {k for k, _, _ in table.entries()} == {"k_90", "k_100"}
+
+    # Explicit gc() between batches keeps reclaiming as before.
+    py, nat = make_pair(4)
+    for table in (py, nat):
+        table.assign_batch(["a", "b"], 0, [10, 20])
+        assert table.gc(15) == 1
+        assert {k for k, _, _ in table.entries()} == {"b"}
+
+
+def test_import_skips_duplicate_keys():
+    """ADVICE r1 (low): a snapshot with duplicate keys must not leak
+    slots (slot marked used but mapping dropped/overwritten)."""
+    entries = [("dup", 0, 100), ("dup", 1, 200), ("other", 2, 300)]
+    py = SlotTable.from_entries(8, entries)
+    nat = native_slot_table.NativeSlotTable.from_entries(8, entries)
+    for table in (py, nat):
+        live = sorted(table.entries())
+        assert live == [("dup", 0, 100), ("other", 2, 300)]
+        assert len(table) == 2
+        # slot 1 must be free again: 6 fresh keys fit (8 - 2 live).
+        keys = [f"n{i}" for i in range(6)]
+        slots, fresh = table.assign_batch(keys, 0, [400] * 6)
+        assert all(fresh)
+        assert len(set(map(int, slots))) == 6
+        assert 1 in set(map(int, slots))

@@ -209,3 +209,12 @@ def test_config_hot_reload(runner):
     assert runner.runtime.force_update()
     resp = _grpc_call(runner, _request("reloaded", [("newkey", "v")]))
     assert resp.statuses[0].current_limit.requests_per_unit == 2
+
+
+def test_runner_wires_settings_reloader(runner):
+    """ADVICE r1 (low): the Runner must hand RateLimitService a
+    settings reloader so SHADOW_MODE / header env flips are re-read on
+    every config reload (reference ratelimit.go:77-89)."""
+    assert runner.service._settings_reloader is not None
+    s = runner.service._settings_reloader()
+    assert hasattr(s, "global_shadow_mode")
