@@ -88,6 +88,12 @@ class _CallToken:
 _STOP = object()
 
 
+class DispatcherDead(RuntimeError):
+    """The dispatcher's collector or completer thread has died; the
+    backend is gone until restart (the Redis analog: a driver whose
+    pool has zero active connections, driver_impl.go:31-52)."""
+
+
 def _slice(d: HostDecisions, lo: int, hi: int) -> HostDecisions:
     return HostDecisions(
         **{f: getattr(d, f)[lo:hi] for f in HostDecisions.__dataclass_fields__}
@@ -136,21 +142,27 @@ def submit_items(engine, items: List[WorkItem]):
         for it in items:
             it.error = e
             it.event.set()
-        return None
+        return _SUBMIT_FAILED
 
 
-def complete_items(engine, items: List[WorkItem], token) -> None:
+_SUBMIT_FAILED = object()  # device-step launch failure (vs None = empty)
+
+
+def complete_items(engine, items: List[WorkItem], token) -> bool:
     """Wait for a submit_items launch, scatter decisions, signal
-    waiters.  Thread-agnostic (touches no engine state)."""
+    waiters.  Thread-agnostic (touches no engine state).  Returns
+    False when the device step failed (launch or readback)."""
     if token is None:
-        return  # submit already failed or was empty
+        return True  # empty batch
+    if token is _SUBMIT_FAILED:
+        return False  # submit already errored the items
     try:
         decisions = engine.step_complete(token)
     except BaseException as e:
         for it in items:
             it.error = e
             it.event.set()
-        return
+        return False
     off = 0
     for it in items:
         n = len(it.lanes)
@@ -160,11 +172,12 @@ def complete_items(engine, items: List[WorkItem], token) -> None:
             it.error = e
         off += n
         it.event.set()
+    return True
 
 
-def run_items(engine, items: List[WorkItem]) -> None:
+def run_items(engine, items: List[WorkItem]) -> bool:
     """Synchronous submit+complete (inline mode, tests)."""
-    complete_items(engine, items, submit_items(engine, items))
+    return complete_items(engine, items, submit_items(engine, items))
 
 
 class BatchDispatcher:
@@ -188,10 +201,24 @@ class BatchDispatcher:
         batch_limit: int = 4096,
         name: str = "tpu-dispatcher",
         pipeline_depth: int = 2,
+        unhealthy_after: int = 3,
+        on_state=None,
     ):
+        """`on_state(healthy: bool, reason: str)` is the backend-health
+        seam (the Redis pool active-connection health analog,
+        driver_impl.go:31-52 + settings.go:91-92): called with False
+        after `unhealthy_after` CONSECUTIVE device-step failures or on
+        dispatcher-thread death, and with True when a later step
+        succeeds.  0 disables failure counting (death still reports)."""
         self.engine = engine
         self.window_s = batch_window_us / 1e6
         self.batch_limit = int(batch_limit)
+        self.unhealthy_after = int(unhealthy_after)
+        self.on_state = on_state
+        self._state_lock = threading.Lock()
+        self._consecutive_failures = 0
+        self._reported_unhealthy = False
+        self._dead: Optional[BaseException] = None
         self._q: "queue.Queue" = queue.Queue()
         # Bounded: backpressure keeps at most pipeline_depth launches
         # in flight ahead of the completer.
@@ -207,21 +234,46 @@ class BatchDispatcher:
         self._thread.start()
         self._completer.start()
 
+    @property
+    def dead(self) -> Optional[BaseException]:
+        return self._dead
+
     def submit(self, item: WorkItem) -> None:
-        self._q.put(item)
+        # Check-dead and enqueue under one lock so an item can never
+        # slip in after the death drain (it would hang its RPC for the
+        # full wait timeout).
+        with self._state_lock:
+            if self._dead is not None:
+                # Fast-fail instead of letting the RPC burn its full
+                # wait timeout against a dispatcher that will never
+                # answer.
+                raise DispatcherDead(
+                    f"batch dispatcher is dead: {self._dead!r}"
+                ) from self._dead
+            self._q.put(item)
 
     def flush(self) -> None:
         """Block until everything submitted before this call has been
         processed (FIFO queue: the token trails all earlier items)."""
         token = _FlushToken()
-        self._q.put(token)
+        with self._state_lock:
+            if self._dead is not None:
+                raise DispatcherDead(
+                    f"batch dispatcher is dead: {self._dead!r}"
+                ) from self._dead
+            self._q.put(token)
         token.event.wait()
 
     def run_on_thread(self, fn, timeout: float = 120.0):
         """Execute `fn()` on the dispatcher thread, after everything
         already queued; blocks for the result."""
         token = _CallToken(fn)
-        self._q.put(token)
+        with self._state_lock:
+            if self._dead is not None:
+                raise DispatcherDead(
+                    f"batch dispatcher is dead: {self._dead!r}"
+                ) from self._dead
+            self._q.put(token)
         if not token.event.wait(timeout):
             raise TimeoutError("dispatcher did not run the call in time")
         if token.error is not None:
@@ -267,39 +319,141 @@ class BatchDispatcher:
     def _launch(self, batch: List[WorkItem]) -> None:
         """Launch on the collector thread, hand to the completer."""
         token = submit_items(self.engine, batch)
-        if token is not None:
-            self._completion_q.put(("batch", batch, token))
+        if token is _SUBMIT_FAILED:
+            self._note_step(False)
+        elif token is not None:
+            self._put_completion(("batch", batch, token))
+
+    def _put_completion(self, entry) -> None:
+        """Bounded put that fails entries fast if the completer dies
+        while the queue is full (instead of blocking the collector
+        forever on a queue nobody drains)."""
+        while True:
+            if self._dead is not None:
+                err = DispatcherDead(
+                    f"batch dispatcher is dead: {self._dead!r}"
+                )
+                kind, payload, _token = entry
+                if kind == "batch":
+                    for it in payload:
+                        it.error = err
+                        it.event.set()
+                elif kind == "token":
+                    if isinstance(payload, _CallToken):
+                        payload.error = err
+                    payload.event.set()
+                return
+            try:
+                self._completion_q.put(entry, timeout=0.2)
+                return
+            except queue.Full:
+                continue
+
+    def _note_step(self, ok: bool) -> None:
+        """Track consecutive device-step failures -> health state (the
+        Redis active-connection health analog)."""
+        cb = None
+        with self._state_lock:
+            if ok:
+                self._consecutive_failures = 0
+                if self._reported_unhealthy:
+                    self._reported_unhealthy = False
+                    cb = (True, "device steps succeeding again")
+            else:
+                self._consecutive_failures += 1
+                if (
+                    self.unhealthy_after > 0
+                    and self._consecutive_failures >= self.unhealthy_after
+                    and not self._reported_unhealthy
+                ):
+                    self._reported_unhealthy = True
+                    cb = (
+                        False,
+                        f"{self._consecutive_failures} consecutive "
+                        "device-step failures",
+                    )
+        if cb is not None and self.on_state is not None:
+            try:
+                self.on_state(*cb)
+            except Exception:
+                pass
+
+    def _die(self, exc: BaseException) -> None:
+        """A dispatcher thread crashed outside per-batch handling:
+        mark dead, fail everything queued/in-flight fast, and report
+        unhealthy.  New submits raise DispatcherDead immediately."""
+        with self._state_lock:
+            if self._dead is None:
+                self._dead = exc
+        err = DispatcherDead(f"batch dispatcher died: {exc!r}")
+        err.__cause__ = exc
+        for q in (self._q, self._completion_q):
+            while True:
+                try:
+                    obj = q.get_nowait()
+                except queue.Empty:
+                    break
+                if isinstance(obj, WorkItem):
+                    obj.error = err
+                    obj.event.set()
+                elif isinstance(obj, (_FlushToken, _CallToken)):
+                    if isinstance(obj, _CallToken):
+                        obj.error = err
+                    obj.event.set()
+                elif isinstance(obj, tuple):
+                    kind, payload, _token = obj
+                    if kind == "batch":
+                        for it in payload:
+                            it.error = err
+                            it.event.set()
+                    elif kind == "token":
+                        if isinstance(payload, _CallToken):
+                            payload.error = err
+                        payload.event.set()
+        if self.on_state is not None:
+            try:
+                self.on_state(False, f"dispatcher thread died: {exc!r}")
+            except Exception:
+                pass
 
     def _collect_loop(self) -> None:
-        while True:
-            batch, tokens, stopping = self._collect()
-            if batch:
-                self._launch(batch)
-            for t in tokens:
-                if isinstance(t, _CallToken):
-                    # Calls (checkpoints) run HERE — the collector owns
-                    # the slot table, and engine counts reflect every
-                    # launch so far (donation chain), so the snapshot
-                    # is consistent without waiting for completions.
-                    self._run_call(t)
-                else:
-                    # Flushes wait for COMPLETION of everything before
-                    # them: route through the completer.
-                    self._completion_q.put(("token", t, None))
-            if stopping:
-                self._drain()
-                self._completion_q.put(("stop", None, None))
-                return
+        try:
+            while True:
+                batch, tokens, stopping = self._collect()
+                if batch:
+                    self._launch(batch)
+                for t in tokens:
+                    if isinstance(t, _CallToken):
+                        # Calls (checkpoints) run HERE — the collector
+                        # owns the slot table, and engine counts
+                        # reflect every launch so far (donation chain),
+                        # so the snapshot is consistent without waiting
+                        # for completions.
+                        self._run_call(t)
+                    else:
+                        # Flushes wait for COMPLETION of everything
+                        # before them: route through the completer.
+                        self._put_completion(("token", t, None))
+                if stopping:
+                    self._drain()
+                    self._completion_q.put(("stop", None, None))
+                    return
+        except BaseException as e:  # noqa: BLE001 — liveness boundary
+            self._die(e)
 
     def _complete_loop(self) -> None:
-        while True:
-            kind, payload, token = self._completion_q.get()
-            if kind == "stop":
-                return
-            if kind == "token":
-                payload.event.set()
-            else:
-                complete_items(self.engine, payload, token)
+        try:
+            while True:
+                kind, payload, token = self._completion_q.get()
+                if kind == "stop":
+                    return
+                if kind == "token":
+                    payload.event.set()
+                else:
+                    ok = complete_items(self.engine, payload, token)
+                    self._note_step(ok)
+        except BaseException as e:  # noqa: BLE001 — liveness boundary
+            self._die(e)
 
     @staticmethod
     def _run_call(t: "_CallToken") -> None:
@@ -329,6 +483,6 @@ class BatchDispatcher:
                 if leftovers:
                     self._launch(leftovers)
                     leftovers = []
-                self._completion_q.put(("token", obj, None))
+                self._put_completion(("token", obj, None))
         if leftovers:
             self._launch(leftovers)

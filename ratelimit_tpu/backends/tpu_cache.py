@@ -67,6 +67,7 @@ class TpuRateLimitCache:
         batch_limit: int = 4096,
         dispatch_timeout_s: float = 120.0,
         pipeline_depth: int = 2,
+        unhealthy_after: int = 3,
     ):
         self.engine = engine
         self.per_second_engine = per_second_engine
@@ -100,6 +101,7 @@ class TpuRateLimitCache:
                 batch_limit,
                 name="tpu-dispatcher",
                 pipeline_depth=pipeline_depth,
+                unhealthy_after=unhealthy_after,
             )
             if per_second_engine is not None:
                 self._dispatchers[id(per_second_engine)] = BatchDispatcher(
@@ -108,6 +110,7 @@ class TpuRateLimitCache:
                     batch_limit,
                     name="tpu-dispatcher-persecond",
                     pipeline_depth=pipeline_depth,
+                    unhealthy_after=unhealthy_after,
                 )
 
     # -- RateLimitCache seam --------------------------------------------
@@ -170,7 +173,17 @@ class TpuRateLimitCache:
             if d is None:
                 inline.append((engine, item))
             else:
-                d.submit(item)
+                try:
+                    d.submit(item)
+                except Exception as e:
+                    # Dead dispatcher: fail THIS rpc immediately (the
+                    # reference's RedisError-on-dead-pool analog) —
+                    # never burn the wait timeout.
+                    from ..service import CacheError
+
+                    raise CacheError(
+                        f"counter engine failure: {e}"
+                    ) from e
         for engine, item in inline:
             with self._inline_locks[id(engine)]:
                 run_items(engine, [item])
@@ -212,6 +225,27 @@ class TpuRateLimitCache:
                     duration_until_reset=duration,
                 )
         return statuses  # type: ignore[return-value]
+
+    def bind_health(self, health) -> None:
+        """Wire backend liveness into the health checker: dispatcher
+        death or N consecutive device-step failures flip /healthcheck
+        and grpc.health.v1 to NOT_SERVING; a later success flips back
+        (the reference's Redis pool active-connection health,
+        driver_impl.go:31-52 + settings.go:91-92)."""
+        import logging
+
+        log = logging.getLogger("ratelimit.health")
+
+        def on_state(healthy: bool, reason: str) -> None:
+            if healthy:
+                log.info("tpu backend healthy again: %s", reason)
+                health.ok()
+            else:
+                log.error("tpu backend unhealthy: %s", reason)
+                health.fail()
+
+        for d in self._dispatchers.values():
+            d.on_state = on_state
 
     def flush(self) -> None:
         """Drain the dispatcher queues (deterministic test hook; the

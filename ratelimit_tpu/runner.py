@@ -101,6 +101,7 @@ def create_limiter(s: Settings, stats_manager: Manager, local_cache, time_source
             batch_limit=s.tpu_batch_limit,
             dispatch_timeout_s=s.tpu_dispatch_timeout_s,
             pipeline_depth=s.tpu_pipeline_depth,
+            unhealthy_after=s.tpu_unhealthy_after,
         )
     raise ValueError(f"Invalid setting for BackendType: {s.backend_type}")
 
@@ -194,6 +195,10 @@ class Runner:
         self.runtime.start()
 
         self.health = HealthChecker()
+        if hasattr(self.cache, "bind_health"):
+            # Backend death -> NOT_SERVING + fast-fail RPCs (the Redis
+            # active-connection health analog, driver_impl.go:31-52).
+            self.cache.bind_health(self.health)
 
         self.grpc_server = create_grpc_server(
             self.service,

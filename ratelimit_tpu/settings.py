@@ -144,6 +144,11 @@ class Settings:
     # Device launches in flight ahead of the completer (readback of
     # batch N overlaps collection+launch of batch N+1).
     tpu_pipeline_depth: int = 2
+    # Flip /healthcheck + grpc.health.v1 to NOT_SERVING after this many
+    # CONSECUTIVE device-step failures (0 disables; dispatcher-thread
+    # death always flips).  The REDIS_HEALTH_CHECK_ACTIVE_CONNECTION
+    # analog (reference settings.go:91-92).
+    tpu_unhealthy_after: int = 3
     # Pre-compile every (bucket, dtype) kernel shape at startup.
     tpu_warmup: bool = False
     # Counter-state checkpointing (closes the restart-amnesia gap the
@@ -201,6 +206,7 @@ def new_settings() -> Settings:
         tpu_batch_limit=_env_int("TPU_BATCH_LIMIT", 4096),
         tpu_dispatch_timeout_s=_env_float("TPU_DISPATCH_TIMEOUT_S", 120.0),
         tpu_pipeline_depth=_env_int("TPU_PIPELINE_DEPTH", 2),
+        tpu_unhealthy_after=_env_int("TPU_UNHEALTHY_AFTER", 3),
         tpu_warmup=_env_bool("TPU_WARMUP", False),
         tpu_checkpoint_dir=_env_str("TPU_CHECKPOINT_DIR", ""),
         tpu_checkpoint_interval_s=_env_float("TPU_CHECKPOINT_INTERVAL_S", 30.0),

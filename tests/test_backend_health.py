@@ -1,0 +1,151 @@
+"""Backend death -> health + fast-fail (round 2, VERDICT #5).
+
+The reference fails its health check when the Redis pool has zero
+active connections (driver_impl.go:31-52, settings.go:91-92).  The TPU
+analog: dispatcher-thread death or N consecutive device-step failures
+flip the HealthChecker to NOT_SERVING and every queued/new RPC errors
+immediately instead of burning the dispatch-wait timeout.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from ratelimit_tpu.backends.dispatcher import (
+    BatchDispatcher,
+    DispatcherDead,
+    Lane,
+    WorkItem,
+)
+from ratelimit_tpu.backends.engine import CounterEngine
+
+
+class _StateLog:
+    def __init__(self):
+        self.events = []
+
+    def __call__(self, healthy, reason):
+        self.events.append((healthy, reason))
+
+
+def _item(key="k", hits=1):
+    return WorkItem(
+        now=0,
+        lanes=[Lane(key=key, expiry=60, limit=10, shadow=False, hits=hits)],
+        apply=lambda d: None,
+    )
+
+
+class _FlakyEngine(CounterEngine):
+    """Engine whose device step can be forced to fail."""
+
+    def __init__(self):
+        super().__init__(num_slots=256, buckets=(8,))
+        self.fail = False
+
+    def step_submit(self, batch):
+        if self.fail:
+            raise RuntimeError("injected device failure")
+        return super().step_submit(batch)
+
+
+def test_consecutive_failures_flip_health_and_recover():
+    engine = _FlakyEngine()
+    log = _StateLog()
+    d = BatchDispatcher(
+        engine, batch_window_us=100, unhealthy_after=3, on_state=log
+    )
+    try:
+        engine.fail = True
+        for i in range(3):
+            it = _item(f"f{i}")
+            d.submit(it)
+            with pytest.raises(RuntimeError, match="injected"):
+                it.wait(10)
+        deadline = time.monotonic() + 5
+        while not log.events and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert log.events and log.events[0][0] is False
+        assert "consecutive" in log.events[0][1]
+        assert d.dead is None  # failures alone don't kill the thread
+
+        # One success flips it back (recovery).
+        engine.fail = False
+        it = _item("ok")
+        d.submit(it)
+        it.wait(30)
+        deadline = time.monotonic() + 5
+        while len(log.events) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert log.events[-1][0] is True
+    finally:
+        d.stop()
+
+
+def test_collector_death_fast_fails_everything():
+    engine = CounterEngine(num_slots=256, buckets=(8,))
+    log = _StateLog()
+    d = BatchDispatcher(
+        engine, batch_window_us=100, unhealthy_after=3, on_state=log
+    )
+    # Poison object: not a WorkItem/token, crashes the collector loop.
+    d._q.put(object())
+    deadline = time.monotonic() + 5
+    while d.dead is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert d.dead is not None
+    assert log.events and log.events[-1][0] is False
+    assert "died" in log.events[-1][1]
+
+    # New submits fail IMMEDIATELY, not after the wait timeout.
+    t0 = time.monotonic()
+    with pytest.raises(DispatcherDead):
+        d.submit(_item("late"))
+    assert time.monotonic() - t0 < 1.0
+    with pytest.raises(DispatcherDead):
+        d.flush()
+    with pytest.raises(DispatcherDead):
+        d.run_on_thread(lambda: None)
+    d.stop()
+
+
+def test_cache_surfaces_dead_dispatcher_as_cache_error():
+    """TpuRateLimitCache.do_limit on a dead dispatcher raises
+    CacheError fast (-> redis_error stat + UNKNOWN at the service
+    boundary), for both the submit path and items already queued."""
+    from ratelimit_tpu.api import Descriptor, RateLimit, RateLimitRequest, Unit
+    from ratelimit_tpu.backends.tpu_cache import TpuRateLimitCache
+    from ratelimit_tpu.config.loader import RateLimitRule
+    from ratelimit_tpu.service import CacheError
+    from ratelimit_tpu.stats.manager import Manager
+
+    engine = CounterEngine(num_slots=256, buckets=(8,))
+    cache = TpuRateLimitCache(
+        engine, batch_window_us=100, dispatch_timeout_s=30.0
+    )
+    try:
+        rule = RateLimitRule(
+            full_key="health.k_v",
+            limit=RateLimit(10, Unit.MINUTE),
+            stats=Manager().rate_limit_stats("health.k_v"),
+        )
+        req = RateLimitRequest(
+            domain="health",
+            descriptors=[Descriptor.of(("k", "v"))],
+            hits_addend=1,
+        )
+        assert cache.do_limit(req, [rule])[0] is not None  # alive
+
+        d = next(iter(cache._dispatchers.values()))
+        d._q.put(object())  # kill the collector
+        deadline = time.monotonic() + 5
+        while d.dead is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+
+        t0 = time.monotonic()
+        with pytest.raises(CacheError):
+            cache.do_limit(req, [rule])
+        assert time.monotonic() - t0 < 1.0  # no 30s timeout burn
+    finally:
+        cache.close()
