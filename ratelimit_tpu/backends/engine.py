@@ -19,7 +19,7 @@ from ..models.fixed_window import DeviceBatch, FixedWindowModel
 # Pad batches up to one of these sizes so XLA compiles a handful of
 # shapes instead of one per batch length (SURVEY.md section 2 SP row:
 # batch-axis bucketing to fixed kernel shapes).
-DEFAULT_BUCKETS = (8, 32, 128, 512, 1024, 2048, 4096)
+DEFAULT_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
 
 
 @dataclass
@@ -243,8 +243,10 @@ class CounterEngine:
         chunks = []
         for start in range(0, n, self.max_batch):
             count = min(n - start, self.max_batch)
-            afters_dev, dedup = self._submit_chunk(batch, start, count)
-            chunks.append((afters_dev, start, count, dedup))
+            afters_dev, dedup, reassemble = self._submit_chunk(
+                batch, start, count
+            )
+            chunks.append((afters_dev, start, count, dedup, reassemble))
         self.stat_live_keys = len(self.slot_table)
         self.stat_evictions = self.slot_table.evictions
         return (batch, chunks)
@@ -257,13 +259,17 @@ class CounterEngine:
         if not chunks:
             empty = np.zeros(0, dtype=np.int32)
             return HostDecisions(*([empty] * 8), empty.astype(bool))
-        outs: List[HostDecisions] = [
-            _decide_host(
-                jax.device_get(afters_dev), batch, start, count,
-                self.model.near_ratio, dedup,
+        outs: List[HostDecisions] = []
+        for afters_dev, start, count, dedup, reassemble in chunks:
+            fetched = jax.device_get(afters_dev)
+            if reassemble is not None:
+                fetched = reassemble(np.asarray(fetched))
+            outs.append(
+                _decide_host(
+                    fetched, batch, start, count,
+                    self.model.near_ratio, dedup,
+                )
             )
-            for afters_dev, start, count, dedup in chunks
-        ]
         if len(outs) == 1:
             return outs[0]
         return HostDecisions(
@@ -287,6 +293,15 @@ class CounterEngine:
             batch.limits[start:end],
             batch.fresh[start:end],
         )
+        afters_dev, reassemble = self._device_submit(dedup)
+        return afters_dev, dedup, reassemble
+
+    def _device_submit(self, dedup: _Dedup):
+        """Launch the device step for one deduped chunk; returns
+        (device afters handle, reassemble-fn or None).  `reassemble`,
+        when set, maps the fetched device array to one (possibly
+        saturated) `after` per unique slot — the sharded engine uses it
+        to unroute per-bank results."""
         g = len(dedup.uniq_slots)
         padded = self._bucket(g)
         # Padding uses DISTINCT out-of-table slots (num_slots + i) so
@@ -341,7 +356,7 @@ class CounterEngine:
                 else self.model.step_counters
             )
             self._counts, afters_dev = fn(self._counts, device_batch)
-        return afters_dev, dedup
+        return afters_dev, None
 
     def reset(self) -> None:
         """Drop all counters and key assignments (tests)."""

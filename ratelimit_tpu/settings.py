@@ -131,7 +131,7 @@ class Settings:
     tpu_per_second: bool = False
     tpu_per_second_num_slots: int = 1 << 20
     tpu_batch_buckets: List[int] = field(
-        default_factory=lambda: [8, 32, 128, 512, 1024, 2048, 4096]
+        default_factory=lambda: [8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096]
     )
     # Micro-batch dispatcher (the implicit-pipelining analog,
     # settings.go:71-77; radix defaults to a 150us window).
@@ -200,7 +200,7 @@ def new_settings() -> Settings:
         tpu_per_second=_env_bool("TPU_PERSECOND", False),
         tpu_per_second_num_slots=_env_int("TPU_PERSECOND_NUM_SLOTS", 1 << 20),
         tpu_batch_buckets=_env_int_list(
-            "TPU_BATCH_BUCKETS", [8, 32, 128, 512, 1024, 2048, 4096]
+            "TPU_BATCH_BUCKETS", [8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096]
         ),
         tpu_batch_window_us=_env_int("TPU_BATCH_WINDOW_US", 200),
         tpu_batch_limit=_env_int("TPU_BATCH_LIMIT", 4096),
