@@ -50,7 +50,37 @@ def link_floor_ms() -> float:
     return best * 1e3
 
 
-def run_config(window_us, batch_limit, threads, requests, descriptors):
+def engine_leg_breakdown(buckets=(1, 8, 64, 512, 1024, 4096)):
+    """Latency of the DEVICE leg alone (engine.step: pad, launch,
+    readback, host decide) per bucket size — separates the dispatcher
+    window/queueing from the device round trip."""
+    import jax  # noqa: F401
+
+    from ratelimit_tpu.backends.engine import CounterEngine, HostBatch
+
+    engine = CounterEngine(num_slots=1 << 18)
+    rows = {}
+    rng = np.random.default_rng(3)
+    for n in buckets:
+        hb = HostBatch(
+            slots=rng.choice(1 << 18, n, replace=False).astype(np.int32),
+            hits=np.ones(n, dtype=np.uint32),
+            limits=np.full(n, 1000, dtype=np.uint32),
+            fresh=np.zeros(n, dtype=bool),
+            shadow=np.zeros(n, dtype=bool),
+        )
+        engine.step(hb)  # compile
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            engine.step(hb)
+            best = min(best, time.perf_counter() - t0)
+        rows[n] = round(best * 1e3, 3)
+    return rows
+
+
+def run_config(window_us, batch_limit, threads, requests, descriptors,
+               qps=0):
     import jax  # noqa: F401  (device selection happens at import)
 
     from ratelimit_tpu.api import Descriptor, RateLimitRequest
@@ -89,20 +119,31 @@ def run_config(window_us, batch_limit, threads, requests, descriptors):
         rules = [rule] * descriptors
 
         latencies = np.zeros(requests)
+        bench_start = [0.0]
 
         def worker(i):
+            if qps > 0:
+                # Open-loop pacing: arrivals at the target rate, so
+                # latency is serving latency, not closed-loop queueing
+                # under total saturation.
+                due = bench_start[0] + i / qps
+                delay = due - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
             t0 = time.perf_counter()
             cache.do_limit(reqs[i], rules)
             latencies[i] = time.perf_counter() - t0
 
         with ThreadPoolExecutor(max_workers=threads) as pool:
             start = time.perf_counter()
+            bench_start[0] = start
             list(pool.map(worker, range(requests)))
             elapsed = time.perf_counter() - start
 
         return {
             "window_us": window_us,
             "batch_limit": batch_limit,
+            "qps_target": qps,
             "decisions_per_sec": round(requests * descriptors / elapsed, 1),
             "p50_ms": round(float(np.percentile(latencies, 50)) * 1e3, 3),
             "p99_ms": round(float(np.percentile(latencies, 99)) * 1e3, 3),
@@ -132,6 +173,14 @@ def main(argv=None):
         help="force a jax platform (e.g. cpu) — the axon sitecustomize "
         "overrides JAX_PLATFORMS, so the env var alone is not enough",
     )
+    p.add_argument(
+        "--qps", type=int, default=0,
+        help="open-loop request pacing (0 = closed-loop saturation)",
+    )
+    p.add_argument(
+        "--breakdown", action="store_true",
+        help="also measure the device leg alone per bucket size",
+    )
     args = p.parse_args(argv)
 
     if args.platform:
@@ -146,11 +195,18 @@ def main(argv=None):
     if not args.json:
         print(f"device={device}  link round-trip floor={floor_ms:.1f}ms")
 
+    breakdown = None
+    if args.breakdown:
+        breakdown = engine_leg_breakdown()
+        if not args.json:
+            print(f"device-leg ms per bucket: {breakdown}")
+
     rows = []
     for window in args.windows:
         for limit in args.limits:
             row = run_config(
-                window, limit, args.threads, args.requests, args.descriptors
+                window, limit, args.threads, args.requests,
+                args.descriptors, qps=args.qps,
             )
             rows.append(row)
             if not args.json:
@@ -166,6 +222,8 @@ def main(argv=None):
         "threads": args.threads,
         "requests": args.requests,
         "descriptors": args.descriptors,
+        "qps_target": args.qps,
+        "device_leg_ms_per_bucket": breakdown,
         "rows": rows,
     }
     if args.json:

@@ -223,7 +223,11 @@ class Runner:
 
         if s.use_statsd:
             self.statsd = StatsdExporter(
-                self.stats_manager.store, s.statsd_host, s.statsd_port
+                self.stats_manager.store,
+                s.statsd_host,
+                s.statsd_port,
+                srv_record=s.statsd_srv,
+                srv_refresh_s=s.statsd_srv_refresh_s,
             )
             self.statsd.start()
 

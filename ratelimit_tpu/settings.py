@@ -103,6 +103,11 @@ class Settings:
     use_statsd: bool = True
     statsd_host: str = "localhost"
     statsd_port: int = 8125
+    # SRV-based statsd discovery (the reference's MEMCACHE_SRV pattern,
+    # src/memcached/cache_impl.go:180-228, applied to the stats sink):
+    # "_statsd._udp.name" overrides host/port; refresh 0 = resolve once.
+    statsd_srv: str = ""
+    statsd_srv_refresh_s: float = 0.0
     extra_tags: Dict[str, str] = field(default_factory=dict)
 
     # Rate limit config runtime (settings.go:40-43).
@@ -178,6 +183,8 @@ def new_settings() -> Settings:
         use_statsd=_env_bool("USE_STATSD", True),
         statsd_host=_env_str("STATSD_HOST", "localhost"),
         statsd_port=_env_int("STATSD_PORT", 8125),
+        statsd_srv=_env_str("STATSD_SRV", ""),
+        statsd_srv_refresh_s=_env_float("STATSD_SRV_REFRESH_S", 0.0),
         extra_tags=_env_tags("EXTRA_TAGS"),
         runtime_path=_env_str("RUNTIME_ROOT", "/srv/runtime_data/current"),
         runtime_subdirectory=_env_str("RUNTIME_SUBDIRECTORY", ""),
