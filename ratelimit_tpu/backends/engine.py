@@ -139,7 +139,14 @@ def _decide_host(
     group-max-limit + group-total, and clamping only engages when the
     true group 'before' exceeds the group-max limit — in which case
     every lane is in the fully-over branch, whose outputs depend only
-    on before >= limit (still true for the clamped value)."""
+    on before >= limit (still true for the clamped value).
+
+    Reconstruction runs in uint32 modular arithmetic — the device
+    counter domain.  The device was handed the group total wrapped to
+    uint32, so the host must subtract (and add prefixes) with the same
+    wrap, or a batch whose same-slot hits sum past 2^32 would yield
+    negative befores here while the device wrapped.  Counters
+    semantically wrap at 2^32 (limits are uint32, far below)."""
     from ..limiter.base import decide_batch
 
     end = start + count
@@ -149,10 +156,12 @@ def _decide_host(
         befores = afters - hits
     else:
         g = len(dedup.uniq_slots)
-        afters_g = afters_padded[:g].astype(np.int64)
-        before_g = afters_g - dedup.totals.astype(np.int64)
-        befores = before_g[dedup.inv] + dedup.prefix.astype(np.int64)
-        afters = befores + hits
+        afters_g = afters_padded[:g].astype(np.uint32)
+        before_g = afters_g - dedup.totals.astype(np.uint32)  # modular
+        befores_u32 = before_g[dedup.inv] + dedup.prefix.astype(np.uint32)
+        afters_u32 = befores_u32 + batch.hits[start:end].astype(np.uint32)
+        befores = befores_u32.astype(np.int64)
+        afters = afters_u32.astype(np.int64)
     d = decide_batch(
         limits=batch.limits[start:end],
         befores=befores,
@@ -334,7 +343,12 @@ class CounterEngine:
         # FixedWindowModel.step_counters_compact for the exactness
         # argument).
         unique_ok = hasattr(self.model, "step_counters_unique")
-        cap = int(hi[:g].max(initial=0)) + int(li[:g].max(initial=1))
+        # Dtype choice must use the UNWRAPPED uint64 totals: a group
+        # whose hits sum past 2^32 wraps hi to a small value, and the
+        # clamped narrow readback's exactness argument does not hold
+        # for wrapped groups — they must ride the raw uint32 path,
+        # where modular reconstruction is exact.
+        cap = int(dedup.totals.max(initial=0)) + int(li[:g].max(initial=1))
         if cap <= 0xFF:
             fn = (
                 self.model.step_counters_unique_compact

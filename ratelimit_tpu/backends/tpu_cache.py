@@ -236,16 +236,32 @@ class TpuRateLimitCache:
 
         log = logging.getLogger("ratelimit.health")
 
-        def on_state(healthy: bool, reason: str) -> None:
-            if healthy:
-                log.info("tpu backend healthy again: %s", reason)
-                health.ok()
-            else:
-                log.error("tpu backend unhealthy: %s", reason)
-                health.fail()
+        # Per-dispatcher health, aggregated: the service is SERVING only
+        # while EVERY bank's dispatcher is healthy — one bank recovering
+        # must not mask the other still being dead.
+        states = {id(d): True for d in self._dispatchers.values()}
+        states_lock = threading.Lock()
+
+        def make_on_state(key: int):
+            def on_state(healthy: bool, reason: str) -> None:
+                # health.ok()/fail() happen INSIDE the lock so state
+                # transitions from concurrent dispatcher threads land
+                # in order — a stale ok() may never overtake a newer
+                # fail().
+                with states_lock:
+                    states[key] = healthy
+                    if healthy:
+                        log.info("tpu backend healthy again: %s", reason)
+                        if all(states.values()):
+                            health.ok()
+                    else:
+                        log.error("tpu backend unhealthy: %s", reason)
+                        health.fail()
+
+            return on_state
 
         for d in self._dispatchers.values():
-            d.on_state = on_state
+            d.on_state = make_on_state(id(d))
 
     def flush(self) -> None:
         """Drain the dispatcher queues (deterministic test hook; the
@@ -301,9 +317,14 @@ class TpuRateLimitCache:
     def warmup(self) -> None:
         """Pre-compile every (bucket, readback-dtype) kernel shape so
         the first real RPC never pays XLA compilation.  Uses inert
-        batches (all lanes point one past the slot table), so counter
-        state and the slot table are untouched.  Call before serving
-        starts — it steps the engines directly."""
+        batches — DISTINCT IN-TABLE slots with hits=0 and fresh=False,
+        which scatter-add zero (or set a counter to its own value on
+        the unique path), so counter state and the slot table are
+        untouched.  In-table slots matter for the sharded engine: its
+        routed path drops out-of-table lanes before bank routing, so
+        out-of-table probes would collapse every bucket to the smallest
+        routed shape and serving would still pay compiles.  Call before
+        serving starts — it steps the engines directly."""
         import numpy as np
 
         for engine in (self.engine, self.per_second_engine):
@@ -314,14 +335,17 @@ class TpuRateLimitCache:
             ns = engine.model.num_slots
             for bucket in engine.buckets:
                 # One probe per readback dtype (u8 / u16 / u32 caps).
-                # DISTINCT out-of-table slots so the engine's dedup
-                # pass keeps all `bucket` lanes (and therefore compiles
-                # this bucket's shape, not a collapsed one).
+                # Distinct slots so the engine's dedup pass keeps all
+                # `bucket` lanes (and therefore compiles this bucket's
+                # shape, not a collapsed one).  Slots 0..bucket-1 land
+                # in one bank of the sharded engine, compiling its
+                # worst-case (skew) routed width for this bucket.
+                probe_slots = (np.arange(bucket, dtype=np.int64) % ns).astype(
+                    np.int32
+                )
                 for probe_limit in (100, 60_000, 3_000_000_000):
                     batch = HostBatch(
-                        slots=np.arange(ns, ns + bucket, dtype=np.int64).astype(
-                            np.int32
-                        ),
+                        slots=probe_slots,
                         hits=np.zeros(bucket, np.uint32),
                         limits=np.full(bucket, probe_limit, np.uint32),
                         fresh=np.zeros(bucket, bool),

@@ -308,7 +308,10 @@ class ShardedCounterEngine(CounterEngine):
         device_batch = DeviceBatch(
             slots=sl, hits=hi, limits=li, fresh=fr, shadow=sh
         )
-        cap_val = int(hi[banks, pos].max(initial=0)) + int(
+        # Unwrapped uint64 totals for the dtype choice (see
+        # CounterEngine._device_submit): wrapped groups must take the
+        # raw uint32 path, never the clamped narrow readback.
+        cap_val = int(dedup.totals[vi].max(initial=0)) + int(
             li[banks, pos].max(initial=1)
         )
         if cap_val <= 0xFF:

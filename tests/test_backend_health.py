@@ -149,3 +149,47 @@ def test_cache_surfaces_dead_dispatcher_as_cache_error():
         assert time.monotonic() - t0 < 1.0  # no 30s timeout burn
     finally:
         cache.close()
+
+
+def test_health_requires_every_dispatcher_healthy():
+    """Two banks (main + per-second): when both go unhealthy, one bank
+    recovering must NOT flip the service back to SERVING while the
+    other is still failing — only all-banks-healthy calls health.ok()
+    (round-3 advisor finding)."""
+    from ratelimit_tpu.backends.tpu_cache import TpuRateLimitCache
+
+    class _FakeHealth:
+        def __init__(self):
+            self.calls = []
+
+        def ok(self):
+            self.calls.append("ok")
+
+        def fail(self):
+            self.calls.append("fail")
+
+    main = CounterEngine(num_slots=256, buckets=(8,))
+    per_second = CounterEngine(num_slots=256, buckets=(8,))
+    cache = TpuRateLimitCache(
+        main, per_second_engine=per_second, batch_window_us=100
+    )
+    try:
+        health = _FakeHealth()
+        cache.bind_health(health)
+        d_main, d_ps = (
+            cache._dispatchers[id(main)],
+            cache._dispatchers[id(per_second)],
+        )
+
+        d_main.on_state(False, "bank0 down")
+        d_ps.on_state(False, "bank1 down")
+        assert health.calls == ["fail", "fail"]
+
+        d_main.on_state(True, "bank0 back")  # bank1 still down
+        assert "ok" not in health.calls
+
+        d_ps.on_state(True, "bank1 back")
+        assert health.calls[-1] == "ok"
+        assert health.calls.count("ok") == 1
+    finally:
+        cache.close()

@@ -172,3 +172,68 @@ def test_engine_dedup_saturation_mixed_limits():
             np.testing.assert_array_equal(
                 getattr(got, f), getattr(want, f), err_msg=f"step {step} {f}"
             )
+
+
+def test_dedup_group_total_past_uint32_stays_in_counter_domain():
+    """A batch whose same-slot hits sum past 2^32 must reconstruct
+    befores/afters in the device's uint32 modular domain — never
+    negative, and the table counter must equal the wrapped total
+    (round-3 advisor finding: the device wrapped while the host
+    subtracted the unwrapped uint64 total)."""
+    e = CounterEngine(num_slots=NUM_SLOTS, buckets=(8,))
+    half = np.uint32(0x8000_0000)
+    hb = HostBatch(
+        slots=np.array([7, 7], dtype=np.int32),  # same slot
+        hits=np.array([half, half], dtype=np.uint32),  # sums to 2^32
+        limits=np.full(2, 10, dtype=np.uint32),
+        fresh=np.zeros(2, dtype=bool),
+        shadow=np.zeros(2, dtype=bool),
+    )
+    d = e.step(hb)
+    assert (d.befores >= 0).all(), d.befores
+    assert (d.afters >= 0).all(), d.afters
+    assert (d.befores < 1 << 32).all() and (d.afters < 1 << 32).all()
+    # Pipeline order: lane0 sees before=0, after=2^31 (over the limit);
+    # lane1's after wraps to 0 — exactly what a uint32 counter does.
+    assert d.befores[0] == 0 and d.afters[0] == int(half)
+    assert d.befores[1] == int(half) and d.afters[1] == 0
+    # Partial-hit attribution: before=0 < limit, so over_limit counts
+    # after-limit (base_limiter.go:150-165 semantics).
+    assert int(d.over_limit[0]) == int(half) - 10
+    # The stored counter is the wrapped group total.
+    assert e.export_counts()[7] == 0
+
+
+def test_wrapped_group_rides_raw_readback_not_clamped():
+    """A wrapped group total must force the raw uint32 readback: the
+    wrapped hi (0 for a 2^32 total) would otherwise pick the uint8
+    clamped path, whose saturation argument breaks on a counter that
+    already holds a value — a truly over-limit lane would come back
+    OK (round-3 review finding)."""
+    e = CounterEngine(num_slots=NUM_SLOTS, buckets=(8,))
+    half = np.uint32(0x8000_0000)
+
+    def mk(slots, hits, limits):
+        n = len(slots)
+        return HostBatch(
+            slots=np.asarray(slots, dtype=np.int32),
+            hits=np.asarray(hits, dtype=np.uint32),
+            limits=np.asarray(limits, dtype=np.uint32),
+            fresh=np.zeros(n, dtype=bool),
+            shadow=np.zeros(n, dtype=bool),
+        )
+
+    # Seed the counter to 200 (limit 10: already far over).
+    e.step(mk([7], [200], [10]))
+    # Two same-slot lanes summing to exactly 2^32 (wrapped total 0).
+    d = e.step(mk([7, 7], [half, half], [10, 10]))
+    # Device counter: (200 + 2^32) mod 2^32 = 200.
+    assert e.export_counts()[7] == 200
+    # Both lanes are fully over: before >= limit for each.
+    assert d.befores[0] == 200
+    assert d.afters[0] == 200 + int(half)
+    assert d.befores[1] == (200 + int(half)) % (1 << 32)
+    assert d.afters[1] == 200  # wrapped
+    assert (np.asarray(d.codes) == 2).all(), d.codes  # OVER_LIMIT
+    assert int(d.over_limit[0]) == int(half)  # fully-over: all hits
+    assert int(d.over_limit[1]) == int(half)
