@@ -11,21 +11,43 @@
 // per-descriptor interpreter cost disappears from the dispatcher
 // thread.
 //
+// sk_assign_dedup_batch additionally folds the host-side duplicate
+// aggregation (engine.py _dedup_chunk) into the SAME walk: while
+// assigning each key it accumulates per-group hit totals, per-lane
+// exclusive prefixes (Redis-pipeline order), group freshness and
+// max-limit, and hands back the groups in sorted-slot order — one
+// C call replaces assign_batch + np.unique + three scatter passes on
+// the dispatcher thread.
+//
 // The reference has no native code (SURVEY.md section 2: pure Go); the
 // analog of this component is Redis's keyspace itself — the piece of
 // the reference's hot path that lived outside Go.
 //
-// Build: make native   (g++ -O2 -shared -fPIC -> libslottable.so)
+// Build: make native   (g++ -O2 -std=c++20 -shared -fPIC -> libslottable.so)
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <functional>
+#include <numeric>
 #include <queue>
 #include <string>
+#include <string_view>
 #include <unordered_map>
+#include <unordered_set>
 #include <utility>
 #include <vector>
 
 namespace {
+
+// Transparent hashing: map lookups take string_view slices of the key
+// blob directly — no per-lane std::string allocation on the hot path.
+struct SvHash {
+  using is_transparent = void;
+  size_t operator()(std::string_view s) const {
+    return std::hash<std::string_view>{}(s);
+  }
+};
 
 struct HeapItem {
   int64_t expiry;
@@ -36,35 +58,41 @@ struct HeapItem {
   }
 };
 
+using KeyMap = std::unordered_map<std::string, std::pair<int64_t, int64_t>,
+                                  SvHash, std::equal_to<>>;
+// Pins are slot ids, not keys: "this slot was handed out in the
+// in-flight batch" is the invariant, and integer pins avoid string
+// copies entirely.
+using PinSet = std::unordered_set<int64_t>;
+
 struct SlotTable {
   int64_t num_slots;
-  std::unordered_map<std::string, std::pair<int64_t, int64_t>> map;  // key -> (slot, expiry)
+  KeyMap map;  // key -> (slot, expiry)
   std::vector<int64_t> free_slots;  // LIFO, matches python list.pop()
   std::priority_queue<HeapItem, std::vector<HeapItem>, std::greater<HeapItem>> heap;
   int64_t evictions = 0;
   // Cross-call pinning (sk_begin_batch/sk_end_batch protocol); when
-  // inactive, each sk_assign_batch call uses its own local pin set.
+  // inactive, each assign call uses its own local pin set.
   bool batch_active = false;
-  std::unordered_map<std::string, bool> persistent_pins;
+  PinSet persistent_pins;
 
   explicit SlotTable(int64_t n) : num_slots(n) {
     free_slots.reserve(n);
     for (int64_t s = 0; s < n; ++s) free_slots.push_back(n - 1 - s);
   }
 
-  // Pinned keys (slots already handed out in the in-flight batch) are
-  // skipped and re-queued: reclaiming one mid-batch would alias two
-  // live keys in one device step (same rule as evict_one).
-  int64_t gc(int64_t now,
-             const std::unordered_map<std::string, bool>* pinned = nullptr) {
+  // Pinned slots (handed out in the in-flight batch) are skipped and
+  // re-queued: reclaiming one mid-batch would alias two live keys in
+  // one device step (same rule as evict_one).
+  int64_t gc(int64_t now, const PinSet* pinned = nullptr) {
     int64_t freed = 0;
     std::vector<HeapItem> skipped;
     while (!heap.empty() && heap.top().expiry <= now) {
       HeapItem item = heap.top();
       heap.pop();
-      auto it = map.find(item.key);
+      auto it = map.find(std::string_view(item.key));
       if (it == map.end() || it->second.second != item.expiry) continue;
-      if (pinned && pinned->count(item.key)) {
+      if (pinned && pinned->count(it->second.first)) {
         skipped.push_back(std::move(item));
         continue;
       }
@@ -78,15 +106,15 @@ struct SlotTable {
 
   // Returns false when the table is exhausted (batch pins more live
   // keys than slots).
-  bool evict_one(const std::unordered_map<std::string, bool>* pinned) {
+  bool evict_one(const PinSet* pinned) {
     std::vector<HeapItem> skipped;
     bool ok = false;
     while (!heap.empty()) {
       HeapItem item = heap.top();
       heap.pop();
-      auto it = map.find(item.key);
+      auto it = map.find(std::string_view(item.key));
       if (it == map.end() || it->second.second != item.expiry) continue;
-      if (pinned && pinned->count(item.key)) {
+      if (pinned && pinned->count(it->second.first)) {
         skipped.push_back(std::move(item));
         continue;
       }
@@ -98,6 +126,30 @@ struct SlotTable {
     }
     for (auto& s : skipped) heap.push(std::move(s));
     return ok;
+  }
+
+  // Assign one key; returns (slot, fresh) via out params, false on
+  // exhaustion.  `pinned` accumulates every slot handed out.
+  bool assign_one(std::string_view key, int64_t now, int64_t expiry,
+                  PinSet& pinned, int64_t* out_slot, bool* out_fresh) {
+    auto it = map.find(key);
+    if (it != map.end()) {
+      *out_slot = it->second.first;
+      *out_fresh = false;
+      pinned.insert(it->second.first);
+      return true;
+    }
+    if (free_slots.empty()) gc(now, &pinned);
+    if (free_slots.empty() && !evict_one(&pinned)) return false;
+    int64_t slot = free_slots.back();
+    free_slots.pop_back();
+    std::string owned(key);
+    heap.push(HeapItem{expiry, owned});
+    map.emplace(std::move(owned), std::make_pair(slot, expiry));
+    pinned.insert(slot);
+    *out_slot = slot;
+    *out_fresh = true;
+    return true;
   }
 };
 
@@ -125,7 +177,7 @@ int64_t sk_gc(void* tp, int64_t now) {
 //   expiries[n]:            per-key expiry (ignored for known keys)
 //   out_slots[n], out_fresh[n]
 // Keys appearing twice in the batch get the same slot (second sight is
-// not fresh).  All newly-assigned keys in the batch are pinned against
+// not fresh).  All slots handed out in the batch are pinned against
 // eviction until the call returns.  Returns 0 on success, -1 when the
 // table is exhausted (more pinned live keys than slots).
 int64_t sk_assign_batch(void* tp, const uint8_t* key_blob,
@@ -133,33 +185,102 @@ int64_t sk_assign_batch(void* tp, const uint8_t* key_blob,
                         const int64_t* expiries, int64_t* out_slots,
                         uint8_t* out_fresh) {
   SlotTable* t = static_cast<SlotTable*>(tp);
-  std::unordered_map<std::string, bool> local_pins;
-  std::unordered_map<std::string, bool>& pinned =
-      t->batch_active ? t->persistent_pins : local_pins;
-  const uint8_t* p = key_blob;
+  PinSet local_pins;
+  PinSet& pinned = t->batch_active ? t->persistent_pins : local_pins;
+  const char* p = reinterpret_cast<const char*>(key_blob);
   for (int64_t i = 0; i < n; ++i) {
-    std::string key(reinterpret_cast<const char*>(p), key_lens[i]);
+    std::string_view key(p, static_cast<size_t>(key_lens[i]));
     p += key_lens[i];
-    auto it = t->map.find(key);
-    if (it != t->map.end()) {
-      // Existing keys are pinned too: their slot was handed out in
-      // this batch and must not be evicted for a later lane.
-      out_slots[i] = it->second.first;
-      out_fresh[i] = 0;
-      pinned.emplace(std::move(key), true);
-      continue;
-    }
-    if (t->free_slots.empty()) t->gc(now, &pinned);
-    if (t->free_slots.empty() && !t->evict_one(&pinned)) return -1;
-    int64_t slot = t->free_slots.back();
-    t->free_slots.pop_back();
-    t->map.emplace(key, std::make_pair(slot, expiries[i]));
-    t->heap.push(HeapItem{expiries[i], key});
-    pinned.emplace(std::move(key), true);
+    int64_t slot;
+    bool fresh;
+    if (!t->assign_one(key, now, expiries[i], pinned, &slot, &fresh))
+      return -1;
     out_slots[i] = slot;
-    out_fresh[i] = 1;
+    out_fresh[i] = fresh ? 1 : 0;
   }
   return 0;
+}
+
+// Fused assign + duplicate-slot aggregation (the C++ version of
+// engine.py _dedup_chunk, folded into the assignment walk).
+//
+// Inputs as sk_assign_batch, plus per-lane hits[n] (uint32) and
+// limits[n] (uint32).  Outputs (buffers sized n; only the first g
+// group entries are written):
+//   out_group[n]    lane -> group index, groups in ASCENDING SLOT
+//                   order (matches np.unique's sorted order, which the
+//                   sharded engine's bank routing relies on)
+//   out_uniq[g]     sorted unique slots (int32)
+//   out_totals[g]   per-group hit totals (uint64, unwrapped)
+//   out_prefix[n]   per-lane exclusive same-group prefix of hits, in
+//                   batch order (Redis pipeline-order semantics)
+//   out_freshg[g]   group had a freshly-assigned slot
+//   out_limitmax[g] max limit across the group's lanes
+// Returns g (number of groups), or -1 on table exhaustion.
+int64_t sk_assign_dedup_batch(void* tp, const uint8_t* key_blob,
+                              const int64_t* key_lens, int64_t n, int64_t now,
+                              const int64_t* expiries, const uint32_t* hits,
+                              const uint32_t* limits, int32_t* out_group,
+                              int32_t* out_uniq, uint64_t* out_totals,
+                              uint64_t* out_prefix, uint8_t* out_freshg,
+                              uint32_t* out_limitmax) {
+  SlotTable* t = static_cast<SlotTable*>(tp);
+  PinSet local_pins;
+  PinSet& pinned = t->batch_active ? t->persistent_pins : local_pins;
+
+  std::unordered_map<int64_t, int32_t> slot2gid;
+  slot2gid.reserve(static_cast<size_t>(n));
+  std::vector<int64_t> g_slot;
+  std::vector<uint64_t> g_total;
+  std::vector<uint8_t> g_fresh;
+  std::vector<uint32_t> g_limit;
+  g_slot.reserve(n);
+  g_total.reserve(n);
+  g_fresh.reserve(n);
+  g_limit.reserve(n);
+
+  std::vector<int32_t> lane_gid(static_cast<size_t>(n));
+  const char* p = reinterpret_cast<const char*>(key_blob);
+  for (int64_t i = 0; i < n; ++i) {
+    std::string_view key(p, static_cast<size_t>(key_lens[i]));
+    p += key_lens[i];
+    int64_t slot;
+    bool fresh;
+    if (!t->assign_one(key, now, expiries[i], pinned, &slot, &fresh))
+      return -1;
+    auto [it, inserted] =
+        slot2gid.try_emplace(slot, static_cast<int32_t>(g_slot.size()));
+    int32_t gid = it->second;
+    if (inserted) {
+      g_slot.push_back(slot);
+      g_total.push_back(0);
+      g_fresh.push_back(0);
+      g_limit.push_back(0);
+    }
+    out_prefix[i] = g_total[gid];
+    g_total[gid] += hits[i];
+    if (limits[i] > g_limit[gid]) g_limit[gid] = limits[i];
+    if (fresh) g_fresh[gid] = 1;
+    lane_gid[i] = gid;
+  }
+
+  // Sorted-slot group order (np.unique parity).
+  const int32_t g = static_cast<int32_t>(g_slot.size());
+  std::vector<int32_t> order(g);
+  std::iota(order.begin(), order.end(), 0);
+  std::sort(order.begin(), order.end(), [&](int32_t a, int32_t b) {
+    return g_slot[a] < g_slot[b];
+  });
+  std::vector<int32_t> rank(g);
+  for (int32_t k = 0; k < g; ++k) {
+    rank[order[k]] = k;
+    out_uniq[k] = static_cast<int32_t>(g_slot[order[k]]);
+    out_totals[k] = g_total[order[k]];
+    out_freshg[k] = g_fresh[order[k]];
+    out_limitmax[k] = g_limit[order[k]];
+  }
+  for (int64_t i = 0; i < n; ++i) out_group[i] = rank[lane_gid[i]];
+  return g;
 }
 
 void sk_begin_batch(void* tp) {
@@ -205,19 +326,20 @@ int64_t sk_import(void* tp, const uint8_t* key_blob, const int64_t* key_lens,
                   const int64_t* slots, const int64_t* expiries, int64_t n) {
   SlotTable* t = static_cast<SlotTable*>(tp);
   std::vector<uint8_t> used(t->num_slots, 0);
-  const uint8_t* p = key_blob;
+  const char* p = reinterpret_cast<const char*>(key_blob);
   int64_t loaded = 0;
   for (int64_t i = 0; i < n; ++i) {
-    std::string key(reinterpret_cast<const char*>(p), key_lens[i]);
+    std::string_view key(p, static_cast<size_t>(key_lens[i]));
     p += key_lens[i];
     int64_t slot = slots[i];
     if (slot < 0 || slot >= t->num_slots || used[slot]) continue;
     // Duplicate keys in a snapshot would leak the slot (marked used,
     // but the map emplace would silently fail): keep the first entry.
-    if (t->map.count(key)) continue;
+    if (t->map.find(key) != t->map.end()) continue;
     used[slot] = 1;
-    t->heap.push(HeapItem{expiries[i], key});
-    t->map.emplace(std::move(key), std::make_pair(slot, expiries[i]));
+    std::string owned(key);
+    t->heap.push(HeapItem{expiries[i], owned});
+    t->map.emplace(std::move(owned), std::make_pair(slot, expiries[i]));
     ++loaded;
   }
   t->free_slots.clear();

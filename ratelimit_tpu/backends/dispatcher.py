@@ -30,7 +30,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .engine import HostBatch, HostDecisions
+from .engine import HostDecisions
 
 
 @dataclass(frozen=True)
@@ -44,15 +44,87 @@ class Lane:
     hits: int
 
 
+# One record per lane: every per-lane scalar the engine needs, in a
+# single structured array so the collector concatenates ONE array per
+# item instead of five (np.concatenate cost is per-piece, and a 4096-
+# lane batch is ~1k pieces).  Layout is C-friendly: i64 at offset 0,
+# four u32s after — 24 bytes, naturally aligned.
+LANE_DTYPE = np.dtype(
+    [
+        ("expiry", "<i8"),
+        ("hits", "<u4"),
+        ("limits", "<u4"),
+        ("len", "<u4"),  # utf-8 byte length of this lane's key
+        ("shadow", "<u4"),  # 0/1
+    ]
+)
+
+
+@dataclass
+class LanePack:
+    """One request's engine-bound lanes as pre-packed arrays.
+
+    Built on the RPC thread (tpu_cache._make_item), so the dispatcher's
+    serial collector never walks lanes in Python — it concatenates
+    blobs/meta and hands them to the engine's fused native call
+    (engine.submit_packed).  Keys are pre-encoded utf-8, concatenated;
+    per-lane scalars live in one LANE_DTYPE record array.
+    """
+
+    key_blob: bytes
+    meta: np.ndarray  # LANE_DTYPE[n]
+    # uint8 view of `meta`, precomputed on the RPC thread: structured-
+    # dtype np.concatenate takes a slow path (~9x), so the collector
+    # concatenates raw u8 views and reinterprets once.
+    meta_u8: np.ndarray = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.meta_u8 is None:
+            self.meta_u8 = self.meta.view(np.uint8)
+
+    @property
+    def count(self) -> int:
+        return len(self.meta)
+
+    @staticmethod
+    def from_lanes(lanes: Sequence[Lane]) -> "LanePack":
+        enc = [lane.key.encode("utf-8") for lane in lanes]
+        n = len(enc)
+        meta = np.empty(n, dtype=LANE_DTYPE)
+        for j, (lane, b) in enumerate(zip(lanes, enc)):
+            meta[j] = (
+                lane.expiry,
+                min(lane.hits, 0xFFFFFFFF),
+                lane.limit,
+                len(b),
+                1 if lane.shadow else 0,
+            )
+        return LanePack(key_blob=b"".join(enc), meta=meta)
+
+
 @dataclass
 class WorkItem:
-    """One request's engine-bound lanes + completion callback."""
+    """One request's engine-bound lanes + completion callback.
+
+    Either `lanes` (test/compat surface) or a pre-built `pack` (the
+    serving path); `get_pack()` converts lazily.
+    """
 
     now: int
     lanes: Sequence[Lane]
     apply: Callable[[HostDecisions], None]
+    pack: Optional[LanePack] = None
     event: threading.Event = field(default_factory=threading.Event)
     error: Optional[BaseException] = None
+
+    @property
+    def n_lanes(self) -> int:
+        return self.pack.count if self.pack is not None else len(self.lanes)
+
+    def get_pack(self) -> LanePack:
+        if self.pack is None:
+            self.pack = LanePack.from_lanes(self.lanes)
+        return self.pack
 
     def wait(self, timeout: float = 30.0) -> None:
         # The timeout is a liveness backstop: if the dispatcher died
@@ -107,37 +179,36 @@ def submit_items(engine, items: List[WorkItem]):
     SlotTable.  Returns the engine token for complete_items, or None
     if the batch failed (items are already errored+signalled) or was
     empty (items signalled).
+
+    The serial work here is pure concatenation: each item arrives with a
+    pre-packed LanePack (built on its RPC thread), and slot assignment
+    + dedup happen in ONE fused native call inside submit_packed.
     """
-    total = sum(len(it.lanes) for it in items)
-    if total == 0:
-        for it in items:
-            it.event.set()
-        return None
-    keys: List[str] = []
-    expiries: List[int] = []
-    hits = np.empty(total, dtype=np.uint32)
-    limits = np.empty(total, dtype=np.uint32)
-    shadow = np.empty(total, dtype=bool)
-
     try:
-        j = 0
-        # `now` only drives gc/eviction; items in one batch differ by
-        # at most the batch window.
-        now = max(it.now for it in items)
+        # Single walk over items: gather blobs/meta views and the max
+        # `now` (which only drives gc/eviction; items in one batch
+        # differ by at most the batch window).
+        blobs = []
+        metas = []
+        now = None
         for it in items:
-            for lane in it.lanes:
-                keys.append(lane.key)
-                expiries.append(lane.expiry)
-                hits[j] = min(lane.hits, 0xFFFFFFFF)
-                limits[j] = lane.limit
-                shadow[j] = lane.shadow
-                j += 1
-        # One call assigns (and pins) every key in the combined batch —
-        # a single FFI round trip on the native table.
-        slots64, fresh = engine.slot_table.assign_batch(keys, now, expiries)
-        slots = slots64.astype(np.int32)
-
-        return engine.step_submit(HostBatch(slots, hits, limits, fresh, shadow))
+            p = it.get_pack()
+            blobs.append(p.key_blob)
+            metas.append(p.meta_u8)
+            if now is None or it.now > now:
+                now = it.now
+        if len(metas) == 1:
+            blob, meta = blobs[0], items[0].pack.meta
+        elif metas:
+            blob = b"".join(blobs)
+            meta = np.concatenate(metas).view(LANE_DTYPE)
+        else:
+            meta = ()
+        if len(meta) == 0:
+            for it in items:
+                it.event.set()
+            return None
+        return engine.submit_packed(now, blob, meta)
     except BaseException as e:
         for it in items:
             it.error = e
@@ -158,6 +229,16 @@ def complete_items(engine, items: List[WorkItem], token) -> bool:
         return False  # submit already errored the items
     try:
         decisions = engine.step_complete(token)
+        # One .tolist() per field up front: per-lane reads in the apply
+        # callbacks become plain-int list indexing instead of numpy
+        # scalar extraction (~10x cheaper across a 4096-lane batch —
+        # benchmarks/results/host_path.json status_assembly_loop).
+        decisions = HostDecisions(
+            **{
+                f: getattr(decisions, f).tolist()
+                for f in HostDecisions.__dataclass_fields__
+            }
+        )
     except BaseException as e:
         for it in items:
             it.error = e
@@ -165,7 +246,7 @@ def complete_items(engine, items: List[WorkItem], token) -> bool:
         return False
     off = 0
     for it in items:
-        n = len(it.lanes)
+        n = it.n_lanes
         try:
             it.apply(_slice(decisions, off, off + n))
         except BaseException as e:
@@ -304,7 +385,7 @@ class BatchDispatcher:
                 tokens.append(obj)
                 break  # flush/call short-circuits the window
             batch.append(obj)
-            lanes += len(obj.lanes)
+            lanes += obj.n_lanes
             if lanes >= self.batch_limit:
                 break
             timeout = deadline - time.monotonic()

@@ -122,11 +122,24 @@ def _dedup_chunk(
     )
 
 
+def _decode_keys(blob, lens: np.ndarray) -> List[str]:
+    """Split a length-prefixed utf-8 key blob back into strings (the
+    non-fused fallback path; the native table never needs this)."""
+    if isinstance(blob, np.ndarray):
+        blob = blob.tobytes()
+    keys = []
+    off = 0
+    for ln in lens.tolist():
+        keys.append(blob[off : off + ln].decode("utf-8"))
+        off += ln
+    return keys
+
+
 def _decide_host(
     afters_padded: np.ndarray,
-    batch: "HostBatch",
-    start: int,
-    count: int,
+    hits_u32: np.ndarray,
+    limits_u32: np.ndarray,
+    shadow: np.ndarray,
     near_ratio: float,
     dedup: Optional["_Dedup"] = None,
 ) -> HostDecisions:
@@ -149,8 +162,8 @@ def _decide_host(
     semantically wrap at 2^32 (limits are uint32, far below)."""
     from ..limiter.base import decide_batch
 
-    end = start + count
-    hits = batch.hits[start:end].astype(np.int64)
+    count = len(hits_u32)
+    hits = hits_u32.astype(np.int64)
     if dedup is None:  # afters already per-lane (general device path)
         afters = afters_padded[:count].astype(np.int64)
         befores = afters - hits
@@ -159,16 +172,16 @@ def _decide_host(
         afters_g = afters_padded[:g].astype(np.uint32)
         before_g = afters_g - dedup.totals.astype(np.uint32)  # modular
         befores_u32 = before_g[dedup.inv] + dedup.prefix.astype(np.uint32)
-        afters_u32 = befores_u32 + batch.hits[start:end].astype(np.uint32)
+        afters_u32 = befores_u32 + hits_u32.astype(np.uint32)
         befores = befores_u32.astype(np.int64)
         afters = afters_u32.astype(np.int64)
     d = decide_batch(
-        limits=batch.limits[start:end],
+        limits=limits_u32,
         befores=befores,
         afters=afters,
         hits=hits,
         near_ratio=near_ratio,
-        shadow_mask=batch.shadow[start:end],
+        shadow_mask=shadow,
         local_cache_mask=np.zeros(count, dtype=bool),
     )
     return HostDecisions(
@@ -247,24 +260,130 @@ class CounterEngine:
         batch N's device->host transfer is still in flight (the counts
         donation chain serializes the compute correctly on device).
         Must be called from the thread that owns this engine.
+
+        This entry takes pre-assigned slots (warmup, tests, oracle
+        comparisons); the serving path is `submit_packed`, which fuses
+        slot assignment + dedup into one native call.
         """
         n = len(batch.slots)
         chunks = []
         for start in range(0, n, self.max_batch):
             count = min(n - start, self.max_batch)
-            afters_dev, dedup, reassemble = self._submit_chunk(
-                batch, start, count
+            end = start + count
+            # Host-side duplicate-slot aggregation: same-key lanes
+            # collapse to one device lane (group total + per-lane
+            # prefixes) so the device always runs the unique-slot fast
+            # path (7.5x — benchmarks/PERF_NOTES.md); lanes are rebuilt
+            # in _decide_host.
+            dedup = _dedup_chunk(
+                batch.slots[start:end],
+                batch.hits[start:end],
+                batch.limits[start:end],
+                batch.fresh[start:end],
             )
+            afters_dev, reassemble = self._device_submit(dedup)
             chunks.append((afters_dev, start, count, dedup, reassemble))
         self.stat_live_keys = len(self.slot_table)
         self.stat_evictions = self.slot_table.evictions
-        return (batch, chunks)
+        return (batch.hits, batch.limits, batch.shadow, chunks)
+
+    def submit_packed(self, now: int, key_blob, meta: np.ndarray):
+        """Serving fast path: assign slots AND dedup in one native call
+        per chunk, then launch the device step (no wait).
+
+        Keys arrive pre-encoded as a length-prefixed utf-8 blob and
+        per-lane scalars as one LANE_DTYPE record array (both built on
+        the RPC threads — see dispatcher.LanePack), so the dispatcher's
+        serial path never walks lanes in Python.  Returns the same
+        token shape as step_submit.
+        """
+        n = len(meta)
+        key_lens = meta["len"].astype(np.int64)
+        expiries = np.ascontiguousarray(meta["expiry"])
+        hits = np.ascontiguousarray(meta["hits"])
+        limits = np.ascontiguousarray(meta["limits"])
+        shadow = meta["shadow"].astype(bool)
+        chunks = []
+        table = self.slot_table
+        fused = hasattr(table, "assign_dedup_packed")
+        blob_arr = (
+            np.frombuffer(key_blob, dtype=np.uint8)
+            if isinstance(key_blob, (bytes, bytearray))
+            else key_blob
+        )
+        # Chunks of one submission share pin scope: a key assigned in
+        # chunk 1 must never be evicted for a chunk-2 lane (they are in
+        # flight against the same device pass).
+        multi_fused = fused and n > self.max_batch
+        if multi_fused:
+            offs = np.zeros(n + 1, dtype=np.int64)
+            np.cumsum(key_lens, out=offs[1:])
+            table.begin_batch()
+        # Phase 1 — assign + dedup EVERY chunk before any device
+        # launch: slot-table exhaustion must error the batch before a
+        # single hit is committed to the counters (the old path's
+        # assign-whole-batch-then-step ordering; a mid-batch failure
+        # after partial commits would double-count on client retry).
+        dedups: List[tuple] = []
+        try:
+            if fused:
+                for start in range(0, n, self.max_batch):
+                    count = min(n - start, self.max_batch)
+                    end = start + count
+                    bl = (
+                        blob_arr[offs[start] : offs[end]]
+                        if multi_fused
+                        else blob_arr
+                    )
+                    inv, uniq, totals, prefix, freshg, limitmax = (
+                        table.assign_dedup_packed(
+                            bl,
+                            key_lens[start:end],
+                            now,
+                            expiries[start:end],
+                            hits[start:end],
+                            limits[start:end],
+                        )
+                    )
+                    dedup = _Dedup(
+                        uniq_slots=uniq,
+                        inv=inv,
+                        totals=totals,
+                        prefix=prefix,
+                        fresh=freshg,
+                        limit_max=limitmax,
+                    )
+                    dedups.append((start, count, dedup))
+            else:
+                keys = _decode_keys(key_blob, key_lens)
+                slots64, fresh = table.assign_batch(keys, now, expiries)
+                slots = slots64.astype(np.int32)
+                for start in range(0, n, self.max_batch):
+                    count = min(n - start, self.max_batch)
+                    end = start + count
+                    dedup = _dedup_chunk(
+                        slots[start:end],
+                        hits[start:end],
+                        limits[start:end],
+                        fresh[start:end],
+                    )
+                    dedups.append((start, count, dedup))
+        finally:
+            if multi_fused:
+                table.end_batch()
+        # Phase 2 — launch the device step per chunk.
+        for start, count, dedup in dedups:
+            afters_dev, reassemble = self._device_submit(dedup)
+            chunks.append((afters_dev, start, count, dedup, reassemble))
+        self.stat_live_keys = len(table)
+        self.stat_evictions = table.evictions
+        return (hits, limits, shadow, chunks)
 
     def step_complete(self, token) -> HostDecisions:
         """Block on the readback for a step_submit token and run the
         host threshold state machine.  Thread-agnostic (touches no
         engine state)."""
-        batch, chunks = token
+        hits, limits, shadow, chunks = token
         if not chunks:
             empty = np.zeros(0, dtype=np.int32)
             return HostDecisions(*([empty] * 8), empty.astype(bool))
@@ -273,10 +392,15 @@ class CounterEngine:
             fetched = jax.device_get(afters_dev)
             if reassemble is not None:
                 fetched = reassemble(np.asarray(fetched))
+            end = start + count
             outs.append(
                 _decide_host(
-                    fetched, batch, start, count,
-                    self.model.near_ratio, dedup,
+                    fetched,
+                    hits[start:end],
+                    limits[start:end],
+                    shadow[start:end],
+                    self.model.near_ratio,
+                    dedup,
                 )
             )
         if len(outs) == 1:
@@ -288,23 +412,6 @@ class CounterEngine:
             )
         )
 
-    def _submit_chunk(self, batch: HostBatch, start: int, count: int):
-        end = start + count
-        # Host-side duplicate-slot aggregation: same-key lanes collapse
-        # to one device lane (group total + per-lane prefixes), so the
-        # device batch always has unique slots and can take the fast
-        # path (no sort/prefix/double-scatter on device — 7.5x, see
-        # benchmarks/PERF_NOTES.md).  Results are redistributed to
-        # lanes in _decide_host.
-        dedup = _dedup_chunk(
-            batch.slots[start:end],
-            batch.hits[start:end],
-            batch.limits[start:end],
-            batch.fresh[start:end],
-        )
-        afters_dev, reassemble = self._device_submit(dedup)
-        return afters_dev, dedup, reassemble
-
     def _device_submit(self, dedup: _Dedup):
         """Launch the device step for one deduped chunk; returns
         (device afters handle, reassemble-fn or None).  `reassemble`,
@@ -313,9 +420,51 @@ class CounterEngine:
         to unroute per-bank results."""
         g = len(dedup.uniq_slots)
         padded = self._bucket(g)
-        # Padding uses DISTINCT out-of-table slots (num_slots + i) so
-        # the unique_indices scatter promise holds for every lane.
         ns = self.model.num_slots
+        # Dtype choice must use the UNWRAPPED uint64 totals: a group
+        # whose hits sum past 2^32 wraps the device total to a small
+        # value, and the clamped narrow readback's exactness argument
+        # does not hold for wrapped groups — they must ride the raw
+        # uint32 path, where modular reconstruction is exact.
+        cap = int(dedup.totals.max(initial=0)) + int(
+            dedup.limit_max.max(initial=1)
+        )
+        dt = "uint8" if cap <= 0xFF else ("uint16" if cap <= 0xFFFF else "")
+
+        # Serving fast path: the device returns only `afters` (the
+        # minimal sufficient statistic); the threshold state machine
+        # reruns vectorized on host from (afters, hits, limits) —
+        # bit-identical to the on-device DeviceDecisions path, which
+        # tests/test_counter_model.py locks against both.  When every
+        # group's limit+total fits in uint8/uint16, the saturated
+        # narrow readback shrinks the device->host transfer 4x/2x (see
+        # FixedWindowModel.step_counters_compact for the exactness
+        # argument).
+        if hasattr(self.model, "step_counters_unique_packed"):
+            # Packed transfer: ONE (4, padded) int32 host->device copy
+            # instead of five (each jnp.asarray call costs ~250us of
+            # dispatch overhead regardless of size —
+            # benchmarks/results/host_path.json).  Rows: slots, hits
+            # (u32 bit-pattern), limits (u32 bit-pattern), fresh.
+            # Padding uses DISTINCT out-of-table slots (num_slots + i)
+            # so the unique_indices scatter promise holds.
+            pk = np.empty((4, padded), dtype=np.int32)
+            pk[0, :g] = dedup.uniq_slots
+            pk[1, :g] = dedup.totals.astype(np.uint32).view(np.int32)
+            pk[2, :g] = dedup.limit_max.view(np.int32)
+            pk[3, :g] = dedup.fresh
+            if padded > g:
+                pk[0, g:] = np.arange(ns, ns + (padded - g), dtype=np.int64)
+                pk[1, g:] = 0
+                pk[2, g:] = 1
+                pk[3, g:] = 0
+            self._counts, afters_dev = self.model.step_counters_unique_packed(
+                self._counts, dt, jax.numpy.asarray(pk)
+            )
+            return afters_dev, None
+
+        # Generic-model path (any object with the documented surface):
+        # five separate leaves, unique step when available.
         sl = np.arange(ns, ns + padded, dtype=np.int64).astype(np.int32)
         hi = np.zeros(padded, dtype=np.uint32)
         li = np.ones(padded, dtype=np.uint32)
@@ -333,36 +482,14 @@ class CounterEngine:
             fresh=jax.numpy.asarray(fr),
             shadow=jax.numpy.asarray(sh),
         )
-        # Serving fast path: the device returns only `afters` (the
-        # minimal sufficient statistic); the threshold state machine
-        # reruns vectorized on host from (afters, hits, limits) —
-        # bit-identical to the on-device DeviceDecisions path, which
-        # tests/test_counter_model.py locks against both.  When every
-        # group's limit+total fits in uint8/uint16, the saturated
-        # narrow readback shrinks the device->host transfer 4x/2x (see
-        # FixedWindowModel.step_counters_compact for the exactness
-        # argument).
         unique_ok = hasattr(self.model, "step_counters_unique")
-        # Dtype choice must use the UNWRAPPED uint64 totals: a group
-        # whose hits sum past 2^32 wraps hi to a small value, and the
-        # clamped narrow readback's exactness argument does not hold
-        # for wrapped groups — they must ride the raw uint32 path,
-        # where modular reconstruction is exact.
-        cap = int(dedup.totals.max(initial=0)) + int(li[:g].max(initial=1))
-        if cap <= 0xFF:
+        if dt:
             fn = (
                 self.model.step_counters_unique_compact
                 if unique_ok
                 else self.model.step_counters_compact
             )
-            self._counts, afters_dev = fn(self._counts, "uint8", device_batch)
-        elif cap <= 0xFFFF:
-            fn = (
-                self.model.step_counters_unique_compact
-                if unique_ok
-                else self.model.step_counters_compact
-            )
-            self._counts, afters_dev = fn(self._counts, "uint16", device_batch)
+            self._counts, afters_dev = fn(self._counts, dt, device_batch)
         else:
             fn = (
                 self.model.step_counters_unique

@@ -45,7 +45,7 @@ def _build() -> bool:
     tmp = f"{_SO}.tmp.{os.getpid()}"
     try:
         subprocess.run(
-            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC],
+            ["g++", "-O2", "-std=c++20", "-shared", "-fPIC", "-o", tmp, _SRC],
             check=True,
             capture_output=True,
             timeout=120,
@@ -77,6 +77,14 @@ def _signatures(lib: ctypes.CDLL) -> None:
     lib.sk_assign_batch.restype = i64
     lib.sk_assign_batch.argtypes = [
         ctypes.c_void_p, u8p, i64p, i64, i64, i64p, i64p, u8p,
+    ]
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.sk_assign_dedup_batch.restype = i64
+    lib.sk_assign_dedup_batch.argtypes = [
+        ctypes.c_void_p, u8p, i64p, i64, i64, i64p, u32p, u32p,
+        i32p, i32p, u64p, u64p, u8p, u32p,
     ]
     lib.sk_export_size.restype = i64
     lib.sk_export_size.argtypes = [ctypes.c_void_p, i64p]
@@ -195,6 +203,80 @@ class NativeSlotTable:
     def assign(self, key: str, now: int, expiry: int) -> Tuple[int, bool]:
         slots, fresh = self.assign_batch([key], now, [expiry])
         return int(slots[0]), bool(fresh[0])
+
+    def assign_dedup_packed(
+        self,
+        key_blob: np.ndarray,
+        key_lens: np.ndarray,
+        now: int,
+        expiries: np.ndarray,
+        hits: np.ndarray,
+        limits: np.ndarray,
+    ):
+        """Fused assign + duplicate-slot aggregation in ONE C call (the
+        native version of engine._dedup_chunk folded into the key walk).
+
+        `key_blob` is the concatenated utf-8 keys (uint8 array),
+        `key_lens` int64 per-key lengths; hits/limits uint32 per lane.
+        Returns (inv, uniq_slots, totals, prefix, fresh_g, limit_max)
+        with groups in sorted-slot order (np.unique parity — the
+        sharded engine's bank routing relies on it).
+        """
+        n = len(key_lens)
+        if n == 0:
+            z = np.zeros(0, dtype=np.int32)
+            return (
+                z,
+                z,
+                np.zeros(0, np.uint64),
+                np.zeros(0, np.uint64),
+                np.zeros(0, bool),
+                np.zeros(0, np.uint32),
+            )
+        key_lens = np.ascontiguousarray(key_lens, dtype=np.int64)
+        expiries = np.ascontiguousarray(expiries, dtype=np.int64)
+        hits = np.ascontiguousarray(hits, dtype=np.uint32)
+        limits = np.ascontiguousarray(limits, dtype=np.uint32)
+        out_group = np.empty(n, dtype=np.int32)
+        out_uniq = np.empty(n, dtype=np.int32)
+        out_totals = np.empty(n, dtype=np.uint64)
+        out_prefix = np.empty(n, dtype=np.uint64)
+        out_freshg = np.empty(n, dtype=np.uint8)
+        out_limitmax = np.empty(n, dtype=np.uint32)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        g = self._lib.sk_assign_dedup_batch(
+            self._handle,
+            _u8p(key_blob),
+            _i64p(key_lens),
+            n,
+            int(now),
+            _i64p(expiries),
+            hits.ctypes.data_as(u32p),
+            limits.ctypes.data_as(u32p),
+            out_group.ctypes.data_as(i32p),
+            out_uniq.ctypes.data_as(i32p),
+            out_totals.ctypes.data_as(u64p),
+            out_prefix.ctypes.data_as(u64p),
+            out_freshg.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            out_limitmax.ctypes.data_as(u32p),
+        )
+        if g < 0:
+            raise RuntimeError(
+                "slot table exhausted: batch holds more live keys than "
+                f"slots ({self.num_slots}); raise TPU_NUM_SLOTS above the "
+                "max batch size"
+            )
+        g = int(g)
+        return (
+            out_group,
+            out_uniq[:g],
+            out_totals[:g],
+            out_prefix,
+            out_freshg[:g].astype(bool),
+            out_limitmax[:g],
+        )
 
     # -- checkpoint surface ---------------------------------------------
 

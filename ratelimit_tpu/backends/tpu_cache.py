@@ -44,8 +44,17 @@ from ..utils.time import (
     unit_to_divider,
     window_start,
 )
-from .dispatcher import BatchDispatcher, Lane, WorkItem, run_items
+from .dispatcher import (
+    LANE_DTYPE,
+    BatchDispatcher,
+    LanePack,
+    WorkItem,
+    run_items,
+)
 from .engine import CounterEngine, HostDecisions
+
+# Device code -> api Code without an enum __call__ per lane.
+_CODE_BY_VALUE = {c.value: c for c in Code}
 
 _CAT_NONE = 0  # no matching rule: OK, no stats
 _CAT_ENGINE = 1  # goes to the counter engine
@@ -364,6 +373,12 @@ class TpuRateLimitCache:
         now: int,
         statuses: List[Optional[DescriptorStatus]],
     ) -> WorkItem:
+        """Pack this request's engine-bound lanes into arrays HERE, on
+        the RPC thread: the dispatcher's serial collector then only
+        concatenates packs (dispatcher.submit_items), so per-lane
+        Python cost parallelizes across RPC handler threads instead of
+        bottlenecking the device queue."""
+        n_rows = len(rows)
         jitters = None
         if self.expiration_jitter_max_seconds > 0:
             # Spread slot reclamation like the reference spreads Redis
@@ -374,29 +389,37 @@ class TpuRateLimitCache:
                     self.jitter_rand.randrange(self.expiration_jitter_max_seconds)
                     for _ in rows
                 ]
-        lanes = []
+        enc: List[bytes] = []
+        meta = np.empty(n_rows, dtype=LANE_DTYPE)
+        hits_clamped = min(hits_addend, 0xFFFFFFFF)
+        expiry_by_unit: dict = {}
         for j, i in enumerate(rows):
             rule = limits[i]
             unit = rule.limit.unit
-            expiry = window_start(now, unit) + unit_to_divider(unit)
+            e = expiry_by_unit.get(unit)
+            if e is None:
+                e = expiry_by_unit[unit] = window_start(
+                    now, unit
+                ) + unit_to_divider(unit)
             if jitters is not None:
-                expiry += jitters[j]
-            lanes.append(
-                Lane(
-                    key=keys[i].key,
-                    expiry=expiry,
-                    limit=rule.limit.requests_per_unit,
-                    shadow=rule.shadow_mode,
-                    hits=hits_addend,
-                )
+                e += jitters[j]
+            b = keys[i].key.encode("utf-8")
+            enc.append(b)
+            meta[j] = (
+                e,
+                hits_clamped,
+                rule.limit.requests_per_unit,
+                len(b),
+                1 if rule.shadow_mode else 0,
             )
+        pack = LanePack(key_blob=b"".join(enc), meta=meta)
 
         def apply(decisions: HostDecisions) -> None:
             self._apply_decisions(
                 rows, keys, limits, hits_addend, now, decisions, statuses
             )
 
-        return WorkItem(now=now, lanes=lanes, apply=apply)
+        return WorkItem(now=now, lanes=(), pack=pack, apply=apply)
 
     def _apply_decisions(
         self,
@@ -408,22 +431,42 @@ class TpuRateLimitCache:
         decisions: HostDecisions,
         statuses: List[Optional[DescriptorStatus]],
     ) -> None:
+        # `decisions` fields are plain Python lists here (one tolist()
+        # per batch in dispatcher.complete_items), so every read below
+        # is list indexing on ints — no numpy scalar extraction.  Stat
+        # adds skip zero deltas (most lanes touch exactly one stat).
         reset_cache: dict = {}
+        codes = decisions.codes
+        remaining = decisions.limit_remaining
+        over = decisions.over_limit
+        near = decisions.near_limit
+        within = decisions.within_limit
+        shadow = decisions.shadow_mode
+        set_lc = decisions.set_local_cache
+        local_cache = self.local_cache
         for j, i in enumerate(rows):
             rule = limits[i]
             stats = rule.stats
-            stats.over_limit.add(int(decisions.over_limit[j]))
-            stats.near_limit.add(int(decisions.near_limit[j]))
-            stats.within_limit.add(int(decisions.within_limit[j]))
-            stats.shadow_mode.add(int(decisions.shadow_mode[j]))
-            if self.local_cache is not None and decisions.set_local_cache[j]:
-                self.local_cache.set(
+            v = over[j]
+            if v:
+                stats.over_limit.add(int(v))
+            v = near[j]
+            if v:
+                stats.near_limit.add(int(v))
+            v = within[j]
+            if v:
+                stats.within_limit.add(int(v))
+            v = shadow[j]
+            if v:
+                stats.shadow_mode.add(int(v))
+            if local_cache is not None and set_lc[j]:
+                local_cache.set(
                     keys[i].key, unit_to_divider(rule.limit.unit)
                 )
             statuses[i] = DescriptorStatus(
-                code=Code(int(decisions.codes[j])),
+                code=_CODE_BY_VALUE[int(codes[j])],
                 current_limit=rule.limit,
-                limit_remaining=int(decisions.limit_remaining[j]),
+                limit_remaining=int(remaining[j]),
                 duration_until_reset=self._reset_seconds(rule, now, reset_cache),
             )
 

@@ -167,6 +167,36 @@ class FixedWindowModel:
         sat = jnp.minimum(afters, cap)
         return counts, sat.astype(jnp.dtype(out_dtype))
 
+    @functools.partial(jax.jit, static_argnums=(0, 2), donate_argnums=1)
+    def step_counters_unique_packed(
+        self, counts: jax.Array, out_dtype: str, packed: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Unique fast path fed by ONE packed int32[4, N] transfer.
+
+        Every per-array host->device copy costs ~hundreds of us of
+        dispatch overhead regardless of size, so the engine packs the
+        four live leaves (slots, hits, limits, fresh) as rows of one
+        int32 matrix and this kernel unpacks them on device: hits and
+        limits are uint32 bit-patterns (bitcast, not convert), fresh is
+        0/1.  ``out_dtype`` "" returns raw uint32 afters; "uint8"/
+        "uint16" apply the saturated narrow readback (see
+        step_counters_compact for the exactness argument).  `shadow` is
+        never shipped: the host decides shadow semantics
+        (engine._decide_host), the device only updates counters.
+        """
+        slots = packed[0]
+        hits = jax.lax.bitcast_convert_type(packed[1], jnp.uint32)
+        limits = jax.lax.bitcast_convert_type(packed[2], jnp.uint32)
+        fresh = packed[3] != 0
+        batch = DeviceBatch(
+            slots=slots, hits=hits, limits=limits, fresh=fresh, shadow=fresh
+        )
+        counts, afters = self.update_unique(counts, batch)
+        if out_dtype:
+            cap = limits + hits
+            afters = jnp.minimum(afters, cap).astype(jnp.dtype(out_dtype))
+        return counts, afters
+
     def update_unique(
         self, counts: jax.Array, batch: DeviceBatch
     ) -> Tuple[jax.Array, jax.Array]:

@@ -44,10 +44,10 @@ class _FlakyEngine(CounterEngine):
         super().__init__(num_slots=256, buckets=(8,))
         self.fail = False
 
-    def step_submit(self, batch):
+    def submit_packed(self, *args, **kwargs):
         if self.fail:
             raise RuntimeError("injected device failure")
-        return super().step_submit(batch)
+        return super().submit_packed(*args, **kwargs)
 
 
 def test_consecutive_failures_flip_health_and_recover():

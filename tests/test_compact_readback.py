@@ -47,8 +47,8 @@ def test_compact_saturation_exact(dtype, max_limit, max_hits):
         c_comp, comp = model_compact.step_counters_compact(c_comp, dtype, db)
         assert np.asarray(comp).dtype == np.dtype(dtype)
 
-        d_full = _decide_host(jax.device_get(full), hb, 0, 32, 0.8)
-        d_comp = _decide_host(jax.device_get(comp), hb, 0, 32, 0.8)
+        d_full = _decide_host(jax.device_get(full), hb.hits, hb.limits, hb.shadow, 0.8)
+        d_comp = _decide_host(jax.device_get(comp), hb.hits, hb.limits, hb.shadow, 0.8)
         for f in ("codes", "limit_remaining", "over_limit", "near_limit",
                   "within_limit", "shadow_mode", "set_local_cache"):
             np.testing.assert_array_equal(

@@ -150,7 +150,7 @@ def test_lane_limit_caps_batch():
 
 def test_engine_error_propagates_as_cache_error(clock):
     class BrokenEngine(CounterEngine):
-        def step_submit(self, batch):
+        def submit_packed(self, *args, **kwargs):
             raise RuntimeError("device lost")
 
     mgr = Manager()

@@ -151,3 +151,66 @@ def test_import_skips_duplicate_keys():
         assert all(fresh)
         assert len(set(map(int, slots))) == 6
         assert 1 in set(map(int, slots))
+
+
+def _pack(keys):
+    enc = [k.encode("utf-8") for k in keys]
+    blob = np.frombuffer(b"".join(enc), dtype=np.uint8)
+    lens = np.fromiter((len(b) for b in enc), np.int64, len(enc))
+    return blob, lens
+
+
+def test_fused_assign_dedup_matches_numpy_oracle():
+    """The fused C++ assign+dedup (one walk, round-3 host-path fast
+    path) must reproduce assign_batch + engine._dedup_chunk exactly:
+    same slots, sorted group order, totals, pipeline-order prefixes,
+    freshness, and max-limits — across duplicates, evictions, and
+    multi-call sequences."""
+    from ratelimit_tpu.backends.engine import _dedup_chunk
+
+    rng = np.random.default_rng(23)
+    fused = native_slot_table.NativeSlotTable(24)
+    oracle = native_slot_table.NativeSlotTable(24)
+    now = 0
+    for step in range(120):
+        now += int(rng.integers(0, 3))
+        n = int(rng.integers(1, 16))
+        keys = [f"k{int(rng.integers(0, 40))}_{now // 8}" for _ in range(n)]
+        expiries = np.asarray(
+            [now + int(rng.integers(1, 20)) for _ in range(n)], np.int64
+        )
+        hits = rng.integers(1, 9, n).astype(np.uint32)
+        limits = rng.integers(1, 1000, n).astype(np.uint32)
+        blob, lens = _pack(keys)
+
+        inv, uniq, totals, prefix, freshg, limitmax = (
+            fused.assign_dedup_packed(blob, lens, now, expiries, hits, limits)
+        )
+        slots, fresh = oracle.assign_batch(keys, now, list(expiries))
+        want = _dedup_chunk(slots.astype(np.int32), hits, limits, fresh)
+
+        np.testing.assert_array_equal(uniq, want.uniq_slots)
+        np.testing.assert_array_equal(inv, want.inv)
+        np.testing.assert_array_equal(totals, want.totals)
+        np.testing.assert_array_equal(prefix, want.prefix)
+        np.testing.assert_array_equal(freshg, want.fresh)
+        np.testing.assert_array_equal(limitmax, want.limit_max)
+        # Per-lane slots reconstruct exactly from groups.
+        np.testing.assert_array_equal(uniq[inv], slots)
+        assert len(fused) == len(oracle)
+        assert fused.evictions == oracle.evictions
+
+
+def test_fused_assign_dedup_exhaustion():
+    t = native_slot_table.NativeSlotTable(2)
+    keys = ["a", "b", "c"]
+    blob, lens = _pack(keys)
+    with pytest.raises(RuntimeError, match="slot table exhausted"):
+        t.assign_dedup_packed(
+            blob,
+            lens,
+            0,
+            np.full(3, 100, np.int64),
+            np.ones(3, np.uint32),
+            np.ones(3, np.uint32),
+        )

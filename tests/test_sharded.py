@@ -123,7 +123,7 @@ def test_routed_engine_divides_work_per_bank():
         shadow=np.zeros(n, dtype=bool),
     )
     token = se.step_submit(hb)
-    _batch, chunks = token
+    _hits, _limits, _shadow, chunks = token
     afters_dev, _start, _count, _dedup, reassemble = chunks[0]
     # 256 uniform lanes over 8 banks -> ~32/bank -> cap bucket 128
     # at worst; the full-batch (replicated) design would be 256 wide.
@@ -208,13 +208,13 @@ def test_warmup_compiles_routed_shapes():
     cache = TpuRateLimitCache(se)
 
     seen = []  # (dtype, per-bank routed width)
-    orig = se.model.step_counters_unique_routed
+    orig = se.model.step_counters_unique_routed_packed
 
-    def spy(counts, out_dtype, batch):
-        seen.append((out_dtype, int(np.asarray(batch.slots).shape[1])))
-        return orig(counts, out_dtype, batch)
+    def spy(counts, out_dtype, packed):
+        seen.append((out_dtype, int(np.asarray(packed).shape[2])))
+        return orig(counts, out_dtype, packed)
 
-    se.model.step_counters_unique_routed = spy
+    se.model.step_counters_unique_routed_packed = spy
     cache.warmup()
 
     for bucket in buckets:

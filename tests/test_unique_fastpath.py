@@ -129,7 +129,7 @@ def test_engine_dedup_matches_per_lane_general(seed):
             shadow=jnp.asarray(shadow),
         )
         c_ref, a_ref = model_ref.step_counters(c_ref, db)
-        want = _decide_host(jax.device_get(a_ref), hb, 0, n, 0.8)
+        want = _decide_host(jax.device_get(a_ref), hb.hits, hb.limits, hb.shadow, 0.8)
         # befores/afters may be clamped under the saturated narrow
         # readback (decisions stay exact — that's the contract).
         for f in ("codes", "limit_remaining",
@@ -166,7 +166,7 @@ def test_engine_dedup_saturation_mixed_limits():
         db = DeviceBatch(*(jnp.asarray(a) for a in
                            (slots, hits, limits, fresh, shadow)))
         c_ref, a_ref = model_ref.step_counters(c_ref, db)
-        want = _decide_host(jax.device_get(a_ref), hb, 0, 5, 0.8)
+        want = _decide_host(jax.device_get(a_ref), hb.hits, hb.limits, hb.shadow, 0.8)
         for f in ("codes", "limit_remaining", "over_limit", "near_limit",
                   "within_limit", "shadow_mode", "set_local_cache"):
             np.testing.assert_array_equal(
