@@ -4,11 +4,11 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: all test bench protos native serve check_config smoke_client docker_image e2e clean
+.PHONY: all test bench protos native serve check_config smoke_client docker_image e2e e2e-local clean
 
 # C++ slot table (auto-built on first import too; this forces it).
 native:
-	g++ -O2 -std=c++17 -shared -fPIC \
+	g++ -O2 -std=c++20 -shared -fPIC \
 	  -o ratelimit_tpu/backends/_libslottable.so native/slot_table.cpp
 
 all: test
@@ -49,6 +49,14 @@ e2e:
 	docker compose -f docker-compose-example.yml up --build -d
 	sh integration-test/run-all.sh
 	docker compose -f docker-compose-example.yml down
+
+# Docker-less e2e: real server child process + the same scenarios
+# against its live surfaces; transcript goes to integration-test/results/.
+# (No tee: a pipeline would mask the suite's exit status under /bin/sh.)
+e2e-local:
+	PY=$(PY) sh integration-test/run-local.sh > integration-test/results/local-e2e.txt 2>&1 \
+	  || { cat integration-test/results/local-e2e.txt; exit 1; }
+	cat integration-test/results/local-e2e.txt
 
 clean:
 	find . -name __pycache__ -type d -prune -exec rm -rf {} \;

@@ -1,0 +1,73 @@
+#!/bin/sh
+# Subprocess-level black-box e2e: launches the real server as a child
+# process (`python -m ratelimit_tpu.runner` with the example config)
+# and runs the three reference scenarios against its live HTTP/gRPC/
+# debug surfaces.  This is the docker-less equivalent of the compose
+# stack (run-all.sh): same scenarios — happy path, 429 after quota,
+# shadow mode never blocks — minus the Envoy hop (no envoy binary in
+# this environment; scripts-local/ hits the service surfaces the
+# Envoy rate_limit filter would call).
+#
+# Usage:  sh integration-test/run-local.sh     (or `make e2e-local`,
+# which records the transcript in integration-test/results/).
+set -e
+cd "$(dirname "$0")/.."
+
+PY="${PY:-python}"
+
+echo "# local subprocess e2e | $(date -u +%Y-%m-%dT%H:%M:%SZ) | commit $(git rev-parse --short HEAD 2>/dev/null || echo unknown)"
+
+# A stale server on 8080 would silently absorb the scenarios (and its
+# half-consumed quotas would corrupt them): refuse to run.
+if curl -s -o /dev/null http://localhost:8080/healthcheck; then
+  echo "port 8080 already serving — stop the existing server first"
+  exit 1
+fi
+
+RLROOT=$(mktemp -d)
+mkdir -p "$RLROOT/ratelimit/config"
+cp examples/ratelimit/config/example.yaml "$RLROOT/ratelimit/config/"
+
+# CPU platform for the counter engine (the real chip is bench-only),
+# axon plugin off (it adds ~87ms to every blocked CPU execution —
+# benchmarks/results/README.md).
+export JAX_PLATFORMS=cpu
+export PALLAS_AXON_POOL_IPS=
+
+RUNTIME_ROOT="$RLROOT" RUNTIME_SUBDIRECTORY=ratelimit \
+  TPU_NUM_SLOTS=65536 TPU_BATCH_WINDOW_US=200 \
+  "$PY" -m ratelimit_tpu.runner >"$RLROOT/server.log" 2>&1 &
+SERVER_PID=$!
+cleanup() {
+  kill "$SERVER_PID" 2>/dev/null || true
+  wait "$SERVER_PID" 2>/dev/null || true
+  rm -rf "$RLROOT"
+}
+trap cleanup EXIT
+
+echo "waiting for server (pid $SERVER_PID) ..."
+up=0
+for i in $(seq 1 120); do
+  if ! kill -0 "$SERVER_PID" 2>/dev/null; then
+    echo "server died during startup:"
+    tail -20 "$RLROOT/server.log"
+    exit 1
+  fi
+  if curl -s -o /dev/null http://localhost:8080/healthcheck; then
+    up=1
+    break
+  fi
+  sleep 1
+done
+[ "$up" = "1" ] || { echo "server never came up"; tail -20 "$RLROOT/server.log"; exit 1; }
+echo "server is up"
+
+for script in integration-test/scripts-local/*.sh; do
+  echo "=== $script"
+  if ! PY="$PY" sh "$script"; then
+    echo "--- scenario failed; server log tail:"
+    tail -30 "$RLROOT/server.log"
+    exit 1
+  fi
+done
+echo "ALL LOCAL E2E SCENARIOS PASSED"

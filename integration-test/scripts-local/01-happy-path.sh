@@ -1,0 +1,19 @@
+#!/bin/sh
+# Happy path (compose 01 analog, minus the Envoy hop): the first
+# request against the 1/minute source_cluster/destination_cluster rule
+# is OK over HTTP (200), the health check serves, and the gRPC smoke
+# client gets an OK decision on a fresh descriptor.
+set -e
+
+code=$(curl -s -o /dev/null -w "%{http_code}" -XPOST --data \
+  '{"domain":"rl","descriptors":[{"entries":[{"key":"source_cluster","value":"proxy"},{"key":"destination_cluster","value":"mock"}]}]}' \
+  http://localhost:8080/json)
+[ "$code" = "200" ] || { echo "expected 200, got $code"; exit 1; }
+
+hc=$(curl -s http://localhost:8080/healthcheck)
+[ "$hc" = "OK" ] || { echo "healthcheck said: $hc"; exit 1; }
+
+"${PY:-python}" -m ratelimit_tpu.cli.client --dial_string localhost:8081 \
+  --domain rl --descriptors source_cluster=e2egrpc | grep -q "OK" \
+  || { echo "gRPC client did not get OK"; exit 1; }
+echo ok

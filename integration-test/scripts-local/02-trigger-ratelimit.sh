@@ -1,0 +1,17 @@
+#!/bin/sh
+# 429 after quota (compose 02 analog): the nested foo/bar descriptor
+# is limited to 3/minute; requests 1-3 are 200 and request 4 must be
+# 429 (OVER_LIMIT maps to HTTP 429, reference server_impl.go:102-106).
+set -e
+body='{"domain":"rl","descriptors":[{"entries":[{"key":"foo","value":"e2e"},{"key":"bar","value":"quota"}]}]}'
+for i in 1 2 3; do
+  code=$(curl -s -o /dev/null -w "%{http_code}" -XPOST --data "$body" \
+    http://localhost:8080/json)
+  [ "$code" = "200" ] || { echo "request $i expected 200, got $code"; exit 1; }
+done
+code=$(curl -s -o /tmp/e2e-429.json -w "%{http_code}" -XPOST --data "$body" \
+  http://localhost:8080/json)
+[ "$code" = "429" ] || { echo "expected 429 after quota, got $code"; exit 1; }
+grep -q "OVER_LIMIT" /tmp/e2e-429.json \
+  || { echo "429 body lacks OVER_LIMIT"; exit 1; }
+echo ok

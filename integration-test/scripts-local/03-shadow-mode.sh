@@ -1,0 +1,15 @@
+#!/bin/sh
+# Shadow mode (compose 03 analog): trial_rollout is shadow_mode with a
+# 10/hour limit — hammering it 15x must NEVER 429 (shadow forces OK,
+# reference base_limiter.go:126-132), while the shadow_mode stat on
+# the debug port proves the limit actually tripped.
+set -e
+for i in $(seq 1 15); do
+  code=$(curl -s -o /dev/null -w "%{http_code}" -XPOST --data \
+    '{"domain":"rl","descriptors":[{"entries":[{"key":"trial_rollout","value":"x"}]}]}' \
+    http://localhost:8080/json)
+  [ "$code" = "200" ] || { echo "shadow mode returned $code"; exit 1; }
+done
+curl -s http://localhost:6070/stats | grep -q "trial_rollout.*shadow_mode: [1-9]" \
+  || { echo "shadow_mode stat not incremented"; exit 1; }
+echo ok
