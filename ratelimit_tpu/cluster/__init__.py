@@ -1,0 +1,7 @@
+"""Multi-replica scale-out: key-ownership routing across service
+replicas (the DCN tier above the in-host ICI sharding).
+
+See docs/MULTI_REPLICA.md for the design and its consistency envelope
+vs the reference's shared-Redis model."""
+
+from .router import ReplicaRouter, owner_of, routing_key  # noqa: F401

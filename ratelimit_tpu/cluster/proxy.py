@@ -1,0 +1,118 @@
+"""Standalone front proxy: a gRPC RateLimitService that owns no
+counters — it routes every descriptor to its owning replica
+(cluster/router.py) and merges the answers.
+
+Deploy pattern (docs/MULTI_REPLICA.md): Envoy (or any client) speaks
+the normal rate-limit protocol to this proxy; behind it, N replica
+processes each run the full service with their own device counter
+banks.  The proxy is stateless and horizontally scalable — ownership
+is pure hashing, so any number of proxies agree.
+
+    python -m ratelimit_tpu.cluster.proxy \
+        --replicas 10.0.0.1:8081,10.0.0.2:8081 --port 8082
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+from concurrent import futures
+from typing import List
+
+import grpc
+
+from ..server import pb  # noqa: F401
+
+from envoy.service.ratelimit.v3 import rls_pb2  # noqa: E402
+
+from .router import ReplicaRouter  # noqa: E402
+
+logger = logging.getLogger("ratelimit.cluster.proxy")
+
+RATELIMIT_SERVICE = "envoy.service.ratelimit.v3.RateLimitService"
+
+
+def grpc_transport(channel: grpc.Channel):
+    """Unary transport over an (owned) channel, wire-identical to the
+    stub the reference's clients use."""
+    method = channel.unary_unary(
+        f"/{RATELIMIT_SERVICE}/ShouldRateLimit",
+        request_serializer=rls_pb2.RateLimitRequest.SerializeToString,
+        response_deserializer=rls_pb2.RateLimitResponse.FromString,
+    )
+
+    def call(request: rls_pb2.RateLimitRequest) -> rls_pb2.RateLimitResponse:
+        return method(request, timeout=30)
+
+    return call
+
+
+def build_router(replica_addrs: List[str]) -> ReplicaRouter:
+    channels = [grpc.insecure_channel(a) for a in replica_addrs]
+    return ReplicaRouter(
+        replica_ids=list(replica_addrs),
+        transports=[grpc_transport(c) for c in channels],
+    )
+
+
+def make_server(router: ReplicaRouter, host: str, port: int) -> grpc.Server:
+    def should_rate_limit(request_pb, context):
+        try:
+            return router.should_rate_limit(request_pb)
+        except grpc.RpcError as e:
+            # Propagate the replica's status (e.g. INVALID_ARGUMENT on
+            # empty domain) instead of wrapping it in UNKNOWN.
+            context.abort(e.code(), e.details())
+
+    handler = grpc.method_handlers_generic_handler(
+        RATELIMIT_SERVICE,
+        {
+            "ShouldRateLimit": grpc.unary_unary_rpc_method_handler(
+                should_rate_limit,
+                request_deserializer=rls_pb2.RateLimitRequest.FromString,
+                response_serializer=rls_pb2.RateLimitResponse.SerializeToString,
+            )
+        },
+    )
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
+    server.add_generic_rpc_handlers((handler,))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    if bound == 0:
+        # grpcio returns 0 instead of raising when the bind fails
+        # (same quirk handled in server/grpc_server.py:164-168).
+        raise OSError(f"could not bind cluster proxy to {host}:{port}")
+    return server
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "--replicas",
+        required=True,
+        help="comma-separated replica gRPC addresses (host:port); the "
+        "address strings are the stable hash identities",
+    )
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8082)
+    args = p.parse_args(argv)
+
+    addrs = [a.strip() for a in args.replicas.split(",") if a.strip()]
+    router = build_router(addrs)
+    server = make_server(router, args.host, args.port)
+    server.start()
+    logger.warning(
+        "cluster proxy serving :%d over %d replicas", args.port, len(addrs)
+    )
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    server.stop(grace=5).wait()
+    router.close()
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.WARNING)
+    main()

@@ -1,0 +1,189 @@
+"""Replica router: rendezvous-hash key ownership over service replicas.
+
+The reference scales horizontally with STATELESS replicas sharing one
+Redis (reference README.md deployment; stateless `service` struct,
+src/service/ratelimit.go:32-47) — any replica can serve any key
+because the counters live elsewhere.  This framework's counters live
+in each replica's device HBM, so the multi-replica design inverts:
+each replica OWNS a partition of the keyspace, and a thin router in
+front sends every descriptor to its owning replica — the host-level
+analog of Redis-cluster key-slot routing (driver_impl.go:108-126) and
+of this repo's own slot->bank routing inside one host
+(parallel/sharded.py ShardedCounterEngine).
+
+Ownership is rendezvous hashing (highest-random-weight): for each
+descriptor, every replica id is scored by hash(replica_id | key) and
+the max wins.  vs ``hash(key) % n``: adding/removing one replica moves
+only ~1/n of the keys (and only those keys' windows reset — the same
+amnesia envelope as a Redis node replacement), not a full reshuffle.
+
+Routing granularity is the CACHE-KEY granularity: the reference builds
+the counter key from the domain plus every (key, value) entry of the
+descriptor (cache_key.go:62-74), so routing on (domain, entries) —
+window excluded — pins every window of a given counter to one replica,
+which keeps counting exact without any cross-replica traffic.
+
+The router speaks the wire protos and is transport-agnostic: each
+replica is a callable ``RateLimitRequest -> RateLimitResponse`` (a
+gRPC stub bound by cluster/proxy.py, or an in-process service in
+tests).  Descriptors are split by owner, sub-requests fan out
+concurrently, and the sub-responses merge back preserving descriptor
+order, the OR overall-code rule, and the min-remaining header
+semantics of the single service (service/ratelimit.go:165-209).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Sequence
+
+from ..server import pb  # noqa: F401  (sys.path for generated protos)
+
+from envoy.service.ratelimit.v3 import rls_pb2  # noqa: E402
+
+
+def routing_key(domain: str, descriptor) -> str:
+    """Window-less counter identity of one descriptor: the reference's
+    cache key (cache_key.go:62-74) minus the window-start suffix, so
+    every window of a counter routes to the same owner."""
+    parts = [domain]
+    for entry in descriptor.entries:
+        parts.append(f"{entry.key}_{entry.value}")
+    return "|".join(parts)
+
+
+def _score(replica_id: str, key: str) -> int:
+    h = hashlib.blake2b(
+        f"{replica_id}|{key}".encode("utf-8"), digest_size=8
+    )
+    return int.from_bytes(h.digest(), "big")
+
+
+def owner_of(key: str, replica_ids: Sequence[str]) -> int:
+    """Rendezvous owner: index (into THIS list) of the replica with
+    the highest score; the id strings, not the positions, are the
+    stable identity.  Score ties break toward the lexically-LARGEST
+    id — any reimplementation (a proxy in another language) must use
+    the same rule or tied keys would split across two owners."""
+    best_i = 0
+    best = None
+    for i, rid in enumerate(replica_ids):
+        s = (_score(rid, key), rid)
+        if best is None or s > best:
+            best = s
+            best_i = i
+    return best_i
+
+
+Transport = Callable[[rls_pb2.RateLimitRequest], rls_pb2.RateLimitResponse]
+
+
+class ReplicaRouter:
+    """Fan descriptors out to their owning replicas; merge responses.
+
+    `replicas` maps stable replica ids (addresses) to transports.  The
+    id strings are the hash identity: keep them stable across restarts
+    (use host:port, not list position).
+    """
+
+    def __init__(
+        self,
+        replica_ids: Sequence[str],
+        transports: Sequence[Transport],
+        max_workers: int = 8,
+    ):
+        if len(replica_ids) != len(transports):
+            raise ValueError("replica_ids and transports length mismatch")
+        if not replica_ids:
+            raise ValueError("need at least one replica")
+        if len(set(replica_ids)) != len(replica_ids):
+            raise ValueError("replica ids must be unique")
+        self.replica_ids = list(replica_ids)
+        self.transports = list(transports)
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="replica-router"
+        )
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+
+    def owner_for(self, domain: str, descriptor) -> int:
+        return owner_of(routing_key(domain, descriptor), self.replica_ids)
+
+    def should_rate_limit(
+        self, request: rls_pb2.RateLimitRequest
+    ) -> rls_pb2.RateLimitResponse:
+        n = len(request.descriptors)
+        if n == 0:
+            # Single replica answers the empty/error case so the wire
+            # behavior (INVALID_ARGUMENT on empty domain etc.) is the
+            # service's own, not a router invention.
+            return self.transports[0](request)
+
+        by_owner: Dict[int, List[int]] = {}
+        for i, d in enumerate(request.descriptors):
+            by_owner.setdefault(self.owner_for(request.domain, d), []).append(i)
+
+        if len(by_owner) == 1:
+            owner = next(iter(by_owner))
+            return self.transports[owner](request)
+
+        def sub_call(owner: int, rows: List[int]):
+            sub = rls_pb2.RateLimitRequest(
+                domain=request.domain, hits_addend=request.hits_addend
+            )
+            for i in rows:
+                sub.descriptors.add().CopyFrom(request.descriptors[i])
+            return rows, self.transports[owner](sub)
+
+        # One owner's call runs inline on the request thread (which
+        # would otherwise just block in result()); only the rest go to
+        # the pool — halves pool pressure for the common 2-owner split.
+        owners = list(by_owner.items())
+        futures = [
+            self._pool.submit(sub_call, owner, rows)
+            for owner, rows in owners[1:]
+        ]
+        results = [sub_call(*owners[0])]
+        results.extend(f.result() for f in futures)
+
+        # Merge: statuses back to request order; overall code is the
+        # logical OR (service/ratelimit.go:185-190); headers follow
+        # the sub-response holding the globally-min-remaining limited
+        # descriptor (each service already computed min over its own
+        # subset — the global min is the min over replicas,
+        # ratelimit.go:165-201).  An OVER_LIMIT sub-response wins
+        # min-remaining ties: the single service forces the over-limit
+        # descriptor to be the header minimum (service/ratelimit.py
+        # sets min_remaining=0 on OVER_LIMIT before any comparison).
+        OVER = rls_pb2.RateLimitResponse.OVER_LIMIT
+        out = rls_pb2.RateLimitResponse(
+            overall_code=rls_pb2.RateLimitResponse.OK
+        )
+        statuses = [None] * n
+        best_hdr = None  # ((remaining, not_over), sub_response)
+        for rows, sub_resp in results:
+            if sub_resp.overall_code == OVER:
+                out.overall_code = OVER
+            for j, i in enumerate(rows):
+                statuses[i] = sub_resp.statuses[j]
+            if sub_resp.response_headers_to_add:
+                sub_min = min(
+                    (
+                        s.limit_remaining
+                        for s in sub_resp.statuses
+                        if s.HasField("current_limit")
+                    ),
+                    default=None,
+                )
+                if sub_min is not None:
+                    rank = (sub_min, sub_resp.overall_code != OVER)
+                    if best_hdr is None or rank < best_hdr[0]:
+                        best_hdr = (rank, sub_resp)
+        for s in statuses:
+            out.statuses.add().CopyFrom(s)
+        if best_hdr is not None:
+            for h in best_hdr[1].response_headers_to_add:
+                out.response_headers_to_add.add().CopyFrom(h)
+        return out

