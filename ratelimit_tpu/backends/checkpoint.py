@@ -150,6 +150,10 @@ class CheckpointManager:
         for idx, engine in enumerate(self.cache.engines()):
             if restore_engine(engine, self._bank_path(idx)):
                 restored += 1
+        if restored and hasattr(self.cache, "on_restored"):
+            # Backends with host-side decision state (write-behind's
+            # view) rebuild it from the restored engine.
+            self.cache.on_restored()
         return restored
 
     def checkpoint(self) -> None:

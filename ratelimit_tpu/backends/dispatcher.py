@@ -114,6 +114,11 @@ class WorkItem:
     lanes: Sequence[Lane]
     apply: Callable[[HostDecisions], None]
     pack: Optional[LanePack] = None
+    # Called (with the exception) when the item fails WITHOUT apply()
+    # ever running — the seam for backends that never wait on the item
+    # (write-behind drains its pending-hit accounting here; a silent
+    # skip would inflate its decisions for the rest of the window).
+    on_error: Optional[Callable[[BaseException], None]] = None
     event: threading.Event = field(default_factory=threading.Event)
     error: Optional[BaseException] = None
 
@@ -125,6 +130,17 @@ class WorkItem:
         if self.pack is None:
             self.pack = LanePack.from_lanes(self.lanes)
         return self.pack
+
+    def fail(self, exc: BaseException) -> None:
+        """Mark failed (apply never ran): set error, fire on_error
+        best-effort, release the waiter."""
+        self.error = exc
+        if self.on_error is not None:
+            try:
+                self.on_error(exc)
+            except Exception:
+                pass
+        self.event.set()
 
     def wait(self, timeout: float = 30.0) -> None:
         # The timeout is a liveness backstop: if the dispatcher died
@@ -211,8 +227,7 @@ def submit_items(engine, items: List[WorkItem]):
         return engine.submit_packed(now, blob, meta)
     except BaseException as e:
         for it in items:
-            it.error = e
-            it.event.set()
+            it.fail(e)
         return _SUBMIT_FAILED
 
 
@@ -241,8 +256,7 @@ def complete_items(engine, items: List[WorkItem], token) -> bool:
         )
     except BaseException as e:
         for it in items:
-            it.error = e
-            it.event.set()
+            it.fail(e)
         return False
     off = 0
     for it in items:
@@ -417,8 +431,7 @@ class BatchDispatcher:
                 kind, payload, _token = entry
                 if kind == "batch":
                     for it in payload:
-                        it.error = err
-                        it.event.set()
+                        it.fail(err)
                 elif kind == "token":
                     if isinstance(payload, _CallToken):
                         payload.error = err
@@ -475,8 +488,7 @@ class BatchDispatcher:
                 except queue.Empty:
                     break
                 if isinstance(obj, WorkItem):
-                    obj.error = err
-                    obj.event.set()
+                    obj.fail(err)
                 elif isinstance(obj, (_FlushToken, _CallToken)):
                     if isinstance(obj, _CallToken):
                         obj.error = err
@@ -485,8 +497,7 @@ class BatchDispatcher:
                     kind, payload, _token = obj
                     if kind == "batch":
                         for it in payload:
-                            it.error = err
-                            it.event.set()
+                            it.fail(err)
                     elif kind == "token":
                         if isinstance(payload, _CallToken):
                             payload.error = err

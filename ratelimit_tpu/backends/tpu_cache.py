@@ -40,7 +40,7 @@ from ..limiter.local_cache import LocalCache
 from ..utils.time import (
     TimeSource,
     RealTimeSource,
-    reset_seconds,
+    reset_seconds_cached,
     unit_to_divider,
     window_start,
 )
@@ -472,8 +472,4 @@ class TpuRateLimitCache:
 
     @staticmethod
     def _reset_seconds(rule: RateLimitRule, now: int, cache: dict) -> int:
-        unit = rule.limit.unit
-        d = cache.get(unit)
-        if d is None:
-            d = cache[unit] = reset_seconds(unit, now)
-        return d
+        return reset_seconds_cached(rule.limit.unit, now, cache)

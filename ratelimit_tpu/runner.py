@@ -49,6 +49,28 @@ def create_limiter(s: Settings, stats_manager: Manager, local_cache, time_source
             cache_key_prefix=s.cache_key_prefix,
             expiration_jitter_max_seconds=s.expiration_jitter_max_seconds,
         )
+    if backend == "tpu-write-behind":
+        # Memcached-mode analog: decide on host, commit async
+        # (reference memcached/cache_impl.go:58-174; see
+        # backends/write_behind.py for the envelope).
+        from .backends.engine import CounterEngine
+        from .backends.write_behind import WriteBehindRateLimitCache
+
+        return WriteBehindRateLimitCache(
+            CounterEngine(
+                num_slots=s.tpu_num_slots,
+                near_ratio=s.near_limit_ratio,
+                buckets=tuple(s.tpu_batch_buckets),
+            ),
+            time_source=time_source,
+            local_cache=local_cache,
+            expiration_jitter_max_seconds=s.expiration_jitter_max_seconds,
+            cache_key_prefix=s.cache_key_prefix,
+            batch_window_us=s.tpu_batch_window_us,
+            batch_limit=s.tpu_batch_limit,
+            unhealthy_after=s.tpu_unhealthy_after,
+            pipeline_depth=s.tpu_pipeline_depth,
+        )
     if backend in ("tpu", "tpu-sharded"):
         from .backends.engine import CounterEngine
         from .backends.tpu_cache import TpuRateLimitCache

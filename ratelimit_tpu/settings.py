@@ -121,7 +121,9 @@ class Settings:
     local_cache_size_in_bytes: int = 0
     near_limit_ratio: float = 0.8
     cache_key_prefix: str = ""
-    backend_type: str = "tpu"  # reference default "redis"; ours: tpu|memory
+    # reference default "redis"; ours: tpu | tpu-sharded |
+    # tpu-write-behind (memcached-mode async commits) | memory
+    backend_type: str = "tpu"
 
     # Custom response headers (settings.go:53-59).
     rate_limit_response_headers_enabled: bool = False

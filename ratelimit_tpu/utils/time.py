@@ -40,6 +40,15 @@ def calculate_reset(unit: Unit, time_source: "TimeSource") -> int:
     return reset_seconds(unit, time_source.unix_now())
 
 
+def reset_seconds_cached(unit: Unit, now: int, cache: dict) -> int:
+    """reset_seconds memoized per unit for one request's status
+    assembly (shared by the sync and write-behind backends)."""
+    d = cache.get(unit)
+    if d is None:
+        d = cache[unit] = reset_seconds(unit, now)
+    return d
+
+
 def window_start(now: int, unit: Unit) -> int:
     """Start timestamp of the fixed window containing `now`
     (the ``(now/divider)*divider`` of reference cache_key.go:74)."""
