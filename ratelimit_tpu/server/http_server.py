@@ -11,8 +11,11 @@ API server routes (server_impl.go:110-117, 227-233):
 Debug server routes (server_impl.go:238-269, runner.go:117-124):
 - GET /stats            flat counters/gauges/timers dump
 - GET /rlconfig         current config dump
-- GET /debug/pprof/     pointer to py-spy (Go pprof has no stdlib
-                        Python analog; profiling is external)
+- GET /debug/pprof/     index of the live-introspection endpoints
+- GET /debug/threadz    all-thread stack dump
+- GET /debug/profile    statistical all-thread CPU profile
+- GET /debug/xla_trace  jax.profiler trace capture
+(see server/debug_profiling.py)
 """
 
 from __future__ import annotations
@@ -196,11 +199,8 @@ def add_debug_routes(server: HttpServer, store, service=None) -> None:
 
         server.add_route("GET", "/rlconfig", rlconfig)
 
-    def pprof(h) -> None:
-        h._reply(
-            200,
-            b"python process: use py-spy or jax.profiler for profiling; "
-            b"see /stats for counters\n",
-        )
+    # Live introspection: threadz / sampling CPU profile / XLA trace
+    # (the net-http-pprof analog, reference server_impl.go:238-269).
+    from .debug_profiling import add_profiling_routes
 
-    server.add_route("GET", "/debug/pprof/", pprof)
+    add_profiling_routes(server)

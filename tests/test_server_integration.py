@@ -264,3 +264,61 @@ def test_backend_death_flips_health_and_fast_fails(tmp_path):
         assert exc_info.value.code() == grpc.StatusCode.UNKNOWN
     finally:
         r.stop()
+
+
+def test_debug_introspection_endpoints(runner):
+    """Live introspection (VERDICT r2 #7; reference pprof analog,
+    server_impl.go:238-269): threadz shows real threads, the sampling
+    profiler returns a profile, the xla_trace capture writes a real
+    trace while a serving batch runs."""
+    port = runner.debug_server.bound_port
+
+    status, out = _http(runner, "/debug/pprof/", port=port)
+    assert status == 200 and b"/debug/threadz" in out
+
+    status, out = _http(runner, "/debug/threadz", port=port)
+    assert status == 200
+    text = out.decode()
+    # The dispatcher (collector) thread and this test thread both show.
+    assert "tpu-dispatcher" in text
+    assert "MainThread" in text or "threadz" in text
+
+    status, out = _http(
+        runner, "/debug/profile?seconds=0.3&hz=50", port=port
+    )
+    assert status == 200
+    assert b"statistical cpu profile" in out
+
+    # Capture a trace WHILE a serving batch flows through the engine.
+    import threading as _threading
+
+    traffic_statuses = []
+
+    def traffic():
+        body = json.dumps(
+            {
+                "domain": "basic",
+                "descriptors": [
+                    {"entries": [{"key": "key1", "value": "traced"}]}
+                ],
+            }
+        ).encode()
+        for _ in range(5):
+            s, _ = _http(runner, "/json", body)
+            traffic_statuses.append(s)
+
+    t = _threading.Thread(target=traffic)
+    t.start()
+    status, out = _http(runner, "/debug/xla_trace?seconds=0.5", port=port)
+    t.join()
+    assert status == 200, out
+    # The capture genuinely overlapped served batches (a silently
+    # failing traffic thread would make this a trace of idleness).
+    assert traffic_statuses and all(s == 200 for s in traffic_statuses)
+    text = out.decode()
+    assert "trace written to" in text
+    trace_dir = text.splitlines()[0].split("trace written to ")[1]
+    found = []
+    for root, _dirs, names in os.walk(trace_dir):
+        found.extend(names)
+    assert any(n.endswith((".trace.json.gz", ".pb", ".json.gz")) or "trace" in n for n in found), found
