@@ -234,6 +234,16 @@ class CounterEngine:
 
     # -- host-side key handling -----------------------------------------
 
+    def warmup_probe_slots(self, bucket: int) -> np.ndarray:
+        """In-table slots whose device shape for a `bucket`-lane batch
+        is the WORST case this engine can serve (used by
+        TpuRateLimitCache.warmup to precompile every serving shape).
+        Single-chip: `bucket` distinct slots (wrapping only on tables
+        smaller than the bucket, where the collapsed shape IS the
+        worst achievable)."""
+        ns = self.model.num_slots
+        return (np.arange(bucket, dtype=np.int64) % ns).astype(np.int32)
+
     def assign_slot(self, key: str, now: int, expiry: int):
         return self.slot_table.assign(key, now, expiry)
 

@@ -341,17 +341,14 @@ class TpuRateLimitCache:
                 continue
             from .engine import HostBatch
 
-            ns = engine.model.num_slots
             for bucket in engine.buckets:
                 # One probe per readback dtype (u8 / u16 / u32 caps).
-                # Distinct slots so the engine's dedup pass keeps all
-                # `bucket` lanes (and therefore compiles this bucket's
-                # shape, not a collapsed one).  Slots 0..bucket-1 land
-                # in one bank of the sharded engine, compiling its
-                # worst-case (skew) routed width for this bucket.
-                probe_slots = (np.arange(bucket, dtype=np.int64) % ns).astype(
-                    np.int32
-                )
+                # Distinct in-table slots so the engine's dedup pass
+                # keeps all `bucket` lanes; the engine supplies the
+                # slots that compile its WORST-case routed width for
+                # this bucket (the sharded engine's all-one-bank skew
+                # probe — see ShardedCounterEngine.warmup_probe_slots).
+                probe_slots = engine.warmup_probe_slots(bucket)
                 for probe_limit in (100, 60_000, 3_000_000_000):
                     batch = HostBatch(
                         slots=probe_slots,

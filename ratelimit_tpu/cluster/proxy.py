@@ -57,7 +57,9 @@ def build_router(replica_addrs: List[str]) -> ReplicaRouter:
     )
 
 
-def make_server(router: ReplicaRouter, host: str, port: int) -> grpc.Server:
+def make_server(router: ReplicaRouter, host: str, port: int):
+    """Build the proxy's gRPC server; returns (server, bound_port) —
+    port 0 selects an ephemeral port (tests)."""
     def should_rate_limit(request_pb, context):
         try:
             return router.should_rate_limit(request_pb)
@@ -83,7 +85,7 @@ def make_server(router: ReplicaRouter, host: str, port: int) -> grpc.Server:
         # grpcio returns 0 instead of raising when the bind fails
         # (same quirk handled in server/grpc_server.py:164-168).
         raise OSError(f"could not bind cluster proxy to {host}:{port}")
-    return server
+    return server, bound
 
 
 def main(argv=None) -> None:
@@ -100,10 +102,10 @@ def main(argv=None) -> None:
 
     addrs = [a.strip() for a in args.replicas.split(",") if a.strip()]
     router = build_router(addrs)
-    server = make_server(router, args.host, args.port)
+    server, bound = make_server(router, args.host, args.port)
     server.start()
     logger.warning(
-        "cluster proxy serving :%d over %d replicas", args.port, len(addrs)
+        "cluster proxy serving :%d over %d replicas", bound, len(addrs)
     )
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):

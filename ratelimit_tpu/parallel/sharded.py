@@ -6,6 +6,12 @@ Design (TPU-first, not a translation of the reference's Redis cluster):
   array laid out as (num_banks, slots_per_bank) and sharded over mesh
   axis ``banks`` with ``NamedSharding(P("banks", None))`` — each chip
   holds exactly its bank in HBM.
+- Bank ownership is MODULO-STRIPED: global slot s belongs to bank
+  ``s % num_banks`` at local position ``s // num_banks``.  The host
+  slot table allocates slots densely (0, 1, 2, ...), so contiguous
+  ranges would pile every early key onto bank 0 until it filled —
+  striping spreads work evenly from the very first key (found by the
+  round-3 sharded-server test: 40 keys, one bank).
 - A batch is replicated to every chip.  Under ``shard_map`` each chip
   masks the batch to the slots it owns, runs the same branch-free
   fixed-window decision body as the single-chip model
@@ -18,7 +24,7 @@ This is the Redis-cluster key-slot analog (reference
 src/redis/driver_impl.go:108-126: radix cluster routes each key by hash
 slot) built the SPMD way: instead of routing requests to the owning
 node over TCP, every chip sees every request and ownership is a mask.
-The slot id already encodes the bank (slot // slots_per_bank), so the
+The slot id already encodes the bank (slot % num_banks), so the
 host-side SlotTable needs no changes.
 """
 
@@ -52,7 +58,8 @@ class ShardedFixedWindowModel:
     ``num_slots`` is the GLOBAL slot count; it is rounded up to a
     multiple of the mesh size so every bank is equal-sized (XLA needs
     even sharding).  Slot ids from the host SlotTable index the global
-    space; bank ownership is ``slot // slots_per_bank``.
+    space; bank ownership is ``slot % num_banks`` (modulo striping,
+    see the module docstring).
     """
 
     def __init__(self, num_slots: int, mesh: Mesh, near_ratio: float = 0.8):
@@ -137,7 +144,7 @@ class ShardedFixedWindowModel:
 
         Every `batch` leaf is shaped (num_banks, cap) and sharded over
         the mesh axis: the host routes each unique slot to its owning
-        bank (slot // slots_per_bank -> LOCAL slot ids) exactly the way
+        bank (slot % num_banks -> LOCAL slot ids) exactly the way
         Redis cluster routes keys by hash slot
         (reference driver_impl.go:108-126) — so per-chip work is
         cap ~ batch/num_banks lanes, not the full batch, and no
@@ -251,15 +258,17 @@ class ShardedFixedWindowModel:
 
     def _bank_core(self, counts, batch: DeviceBatch):
         """Shared per-bank counter update; returns (counts, afters,
-        owned) with `afters` valid only on owned lanes (0 elsewhere)."""
+        owned) with `afters` valid only on owned lanes (0 elsewhere).
+        Modulo-striped ownership: bank = slot % num_banks, local
+        position = slot // num_banks."""
         # counts: uint32[1, slots_per_bank] — this chip's bank.
         spb = self.slots_per_bank
+        nb = jnp.int32(self.num_banks)
         bank = jax.lax.axis_index(self.axis)
-        base = (bank * spb).astype(jnp.int32)
 
-        local = batch.slots - base
+        local = batch.slots // nb
         in_table = (batch.slots >= 0) & (batch.slots < self.num_slots)
-        owns_slot = in_table & (batch.slots >= base) & (local < spb)
+        owns_slot = in_table & (batch.slots % nb == bank)
         # Out-of-table lanes (padding) read a virtual zero counter and
         # scatter nowhere; bank 0 claims them so their decisions match
         # the single-chip model lane-for-lane.
@@ -327,9 +336,13 @@ class ShardedCounterEngine(CounterEngine):
 
         valid = (uniq >= 0) & (uniq < m.num_slots)
         vi = np.nonzero(valid)[0]
-        banks = (uniq[vi] // spb).astype(np.int64)
-        # uniq is sorted, so banks is already non-decreasing; positions
-        # within each bank are consecutive.
+        banks_u = (uniq[vi] % nb).astype(np.int64)
+        # Modulo-striped ownership: sorted uniq is NOT bank-grouped, so
+        # order lanes by bank (stable) before computing per-bank
+        # positions.
+        order = np.argsort(banks_u, kind="stable")
+        vi = vi[order]
+        banks = banks_u[order]
         counts_pb = np.bincount(banks, minlength=nb)
         starts = np.concatenate([[0], np.cumsum(counts_pb)])
         pos = np.arange(len(vi)) - starts[banks]
@@ -343,7 +356,7 @@ class ShardedCounterEngine(CounterEngine):
         pk[:, 1, :] = 0
         pk[:, 2, :] = 1
         pk[:, 3, :] = 0
-        pk[banks, 0, pos] = (uniq[vi] % spb).astype(np.int32)
+        pk[banks, 0, pos] = (uniq[vi] // nb).astype(np.int32)
         pk[banks, 1, pos] = totals32[vi].view(np.int32)
         pk[banks, 2, pos] = dedup.limit_max[vi].view(np.int32)
         pk[banks, 3, pos] = dedup.fresh[vi]
@@ -389,6 +402,29 @@ class ShardedCounterEngine(CounterEngine):
             model=ShardedFixedWindowModel(num_slots, mesh, near_ratio),
         )
 
+    def export_counts(self) -> np.ndarray:
+        """Flat uint32 copy in GLOBAL slot order: bank b's local
+        position l holds global slot l*num_banks + b (modulo
+        striping), so the (nb, spb) device layout transposes back."""
+        m = self.model
+        arr = np.asarray(jax.device_get(self._counts)).reshape(
+            m.num_banks, m.slots_per_bank
+        )
+        return arr.T.reshape(-1)
+
+    def warmup_probe_slots(self, bucket: int) -> np.ndarray:
+        """All-one-bank probes: under modulo striping, slots
+        k*num_banks land on bank 0, so this probe's routed cap is the
+        worst (skew) width this engine can ever serve for a
+        `bucket`-lane batch — min(bucket, slots_per_bank), since one
+        bank physically holds at most slots_per_bank distinct slots.
+        The clamp keeps the slots distinct and in-table on small
+        tables/large meshes (bucket > spb)."""
+        m = self.model
+        width = min(int(bucket), m.slots_per_bank)
+        slots = np.arange(width, dtype=np.int64) * m.num_banks
+        return slots.astype(np.int32)
+
     def import_counts(self, counts) -> None:
         arr = np.asarray(counts, dtype=np.uint32).reshape(-1)
         m = self.model
@@ -397,5 +433,8 @@ class ShardedCounterEngine(CounterEngine):
                 f"counts size {arr.shape[0]} != num_slots {m.num_slots}"
             )
         self._counts = jax.device_put(
-            arr.reshape(m.num_banks, m.slots_per_bank), m._counts_sharding
+            np.ascontiguousarray(
+                arr.reshape(m.slots_per_bank, m.num_banks).T
+            ),
+            m._counts_sharding,
         )

@@ -1,0 +1,114 @@
+"""The standalone cluster front proxy, end-to-end over real gRPC.
+
+test_cluster_router.py proves the ROUTER class; this file proves the
+PROXY PROCESS path (cluster/proxy.py make_server + build_router): a
+real gRPC server in front of two real Runners, speaking the normal
+RateLimitService protocol — the deploy topology from
+docs/MULTI_REPLICA.md, in-process (the reference's topology tests run
+local processes the same way, Makefile:74-102)."""
+
+import grpc
+import pytest
+
+from ratelimit_tpu.cluster.proxy import build_router, make_server
+from ratelimit_tpu.runner import Runner
+from ratelimit_tpu.settings import Settings
+
+from ratelimit_tpu.server import pb  # noqa: F401
+from envoy.service.ratelimit.v3 import rls_pb2  # noqa: E402
+
+YAML = """
+domain: px
+descriptors:
+  - key: limited
+    rate_limit:
+      unit: minute
+      requests_per_unit: 3
+"""
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    runners = []
+    for name in ("px0", "px1"):
+        root = tmp_path_factory.mktemp(name)
+        config_dir = root / "ratelimit" / "config"
+        config_dir.mkdir(parents=True)
+        (config_dir / "px.yaml").write_text(YAML)
+        r = Runner(
+            Settings(
+                host="127.0.0.1",
+                port=0,
+                grpc_host="127.0.0.1",
+                grpc_port=0,
+                debug_host="127.0.0.1",
+                debug_port=0,
+                use_statsd=False,
+                backend_type="tpu",
+                tpu_num_slots=1 << 12,
+                tpu_batch_window_us=200,
+                tpu_batch_buckets=[8, 32],
+                runtime_path=str(root),
+                runtime_subdirectory="ratelimit",
+                local_cache_size_in_bytes=0,
+                expiration_jitter_max_seconds=0,
+            )
+        )
+        r.start()
+        runners.append(r)
+
+    addrs = [f"127.0.0.1:{r.grpc_server.bound_port}" for r in runners]
+    router = build_router(addrs)
+    # Port 0: grpcio picks a free port; make_server surfaces it.
+    server, bound = make_server(router, "127.0.0.1", 0)
+    server.start()
+    yield runners, router, server, f"127.0.0.1:{bound}"
+    server.stop(grace=None)
+    router.close()
+    for r in runners:
+        r.stop()
+
+
+def _call(addr, request_pb):
+    with grpc.insecure_channel(addr) as channel:
+        method = channel.unary_unary(
+            "/envoy.service.ratelimit.v3.RateLimitService/ShouldRateLimit",
+            request_serializer=rls_pb2.RateLimitRequest.SerializeToString,
+            response_deserializer=rls_pb2.RateLimitResponse.FromString,
+        )
+        return method(request_pb, timeout=30)
+
+
+def _request(value):
+    req = rls_pb2.RateLimitRequest(domain="px")
+    d = req.descriptors.add()
+    e = d.entries.add()
+    e.key, e.value = "limited", value
+    return req
+
+
+def test_proxy_process_enforces_one_limit(stack):
+    """Clients through the proxy's own gRPC server see one jointly-
+    enforced 3/min limit over two replicas."""
+    runners, router, server, proxy_addr = stack
+    codes = [
+        _call(proxy_addr, _request("joint")).overall_code for _ in range(4)
+    ]
+    OK = rls_pb2.RateLimitResponse.OK
+    OVER = rls_pb2.RateLimitResponse.OVER_LIMIT
+    assert codes == [OK] * 3 + [OVER]
+
+
+def test_proxy_propagates_replica_errors(stack):
+    """An empty domain is the replica's INVALID/UNKNOWN error, not a
+    proxy-wrapped one (proxy.py should_rate_limit abort path)."""
+    runners, router, server, _proxy_addr = stack
+    # Router direct (transport level): replica raises RpcError.
+    req = rls_pb2.RateLimitRequest(domain="")
+    d = req.descriptors.add()
+    e = d.entries.add()
+    e.key, e.value = "limited", "x"
+    with pytest.raises(grpc.RpcError) as err:
+        router.should_rate_limit(req)
+    assert err.value.code() == grpc.StatusCode.UNKNOWN
+    assert "domain" in err.value.details()

@@ -322,3 +322,21 @@ def test_debug_introspection_endpoints(runner):
     for root, _dirs, names in os.walk(trace_dir):
         found.extend(names)
     assert any(n.endswith((".trace.json.gz", ".pb", ".json.gz")) or "trace" in n for n in found), found
+
+
+def test_grpc_hits_addend_wire_level(runner):
+    """hits_addend over the REAL wire (reference wire-level accounting;
+    VERDICT r2 #8): a 5/min limit consumed in 3+3 hits — first OK with
+    remaining 2, second OVER_LIMIT (partial attribution)."""
+    req = _request("basic", [("key1", "wirehits")], hits=3)
+    resp = _grpc_call(runner, req)
+    assert resp.overall_code == rls_pb2.RateLimitResponse.OK
+    assert resp.statuses[0].limit_remaining == 2
+
+    resp = _grpc_call(runner, req)
+    assert resp.overall_code == rls_pb2.RateLimitResponse.OVER_LIMIT
+    assert resp.statuses[0].limit_remaining == 0
+
+    # Third request: fully over.
+    resp = _grpc_call(runner, _request("basic", [("key1", "wirehits")], hits=1))
+    assert resp.overall_code == rls_pb2.RateLimitResponse.OVER_LIMIT

@@ -57,8 +57,11 @@ def test_sharded_matches_single_chip(n_devices):
             np.testing.assert_array_equal(
                 a.astype(np.int64), b.astype(np.int64), err_msg=f"step {step} {field}"
             )
+        # Device layout is bank-major with modulo striping: global
+        # slot s lives at [s % nb, s // nb], so transpose recovers
+        # global order.
         np.testing.assert_array_equal(
-            np.asarray(s_counts).reshape(-1), np.asarray(counts)
+            np.asarray(s_counts).T.reshape(-1), np.asarray(counts)
         )
 
 
@@ -145,8 +148,14 @@ def test_routed_engine_heavy_duplicates_and_skew():
     spb = se.model.slots_per_bank
     for step in range(5):
         n = 40
-        # slots only in bank 0 (max skew), many duplicates
-        slots = rng.integers(0, max(spb // 2, 2), size=n).astype(np.int32)
+        # Slots only in bank 0 (max skew): under modulo striping a
+        # slot s is bank-0-owned iff s % num_banks == 0, so multiples
+        # of num_banks pin the whole batch to one bank.  Small value
+        # range -> many duplicates.
+        nb = se.model.num_banks
+        slots = (
+            rng.integers(0, max(spb // 2, 2), size=n).astype(np.int64) * nb
+        ).astype(np.int32)
         fresh = np.zeros(n, dtype=bool)
         if step == 0:
             seen: set = set()
