@@ -349,13 +349,17 @@ class TpuRateLimitCache:
                 # this bucket (the sharded engine's all-one-bank skew
                 # probe — see ShardedCounterEngine.warmup_probe_slots).
                 probe_slots = engine.warmup_probe_slots(bucket)
+                # Companion arrays sized from the probe, not the
+                # bucket: the sharded engine clamps probe width to
+                # slots_per_bank on small tables.
+                width = len(probe_slots)
                 for probe_limit in (100, 60_000, 3_000_000_000):
                     batch = HostBatch(
                         slots=probe_slots,
-                        hits=np.zeros(bucket, np.uint32),
-                        limits=np.full(bucket, probe_limit, np.uint32),
-                        fresh=np.zeros(bucket, bool),
-                        shadow=np.zeros(bucket, bool),
+                        hits=np.zeros(width, np.uint32),
+                        limits=np.full(width, probe_limit, np.uint32),
+                        fresh=np.zeros(width, bool),
+                        shadow=np.zeros(width, bool),
                     )
                     engine.step(batch)
 

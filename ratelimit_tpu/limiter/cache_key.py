@@ -33,8 +33,18 @@ EMPTY_KEY = CacheKey("", False)
 
 
 class CacheKeyGenerator:
-    def __init__(self, prefix: str = ""):
+    """Builds counter keys; memoizes the window-independent STEM
+    (``<prefix><domain>_<k>_<v>_..._``) per (domain, entries), so hot
+    descriptors cost one dict hit + one concat instead of rebuilding
+    the whole key every request (the reference pools bytes.Buffers for
+    the same reason, cache_key.go:17-29).  The stem is rule-agnostic
+    (the unit only affects the appended window), so config reloads
+    never invalidate it."""
+
+    def __init__(self, prefix: str = "", stem_cache_entries: int = 1 << 16):
         self.prefix = prefix
+        self._stems: dict = {}
+        self._stem_cap = int(stem_cache_entries)
 
     def generate(
         self, domain: str, descriptor: Descriptor, rule: Optional[RateLimitRule], now: int
@@ -53,11 +63,35 @@ class CacheKeyGenerator:
             return EMPTY_KEY
         unit = rule.limit.unit
         window = window_start(now, unit)
-        parts = [self.prefix, domain, "_"]
-        for entry in descriptor.entries:
-            parts.append(entry.key)
-            parts.append("_")
-            parts.append(entry.value)
-            parts.append("_")
-        parts.append(str(window))
-        return CacheKey("".join(parts), unit == Unit.SECOND)
+        per_second = unit == Unit.SECOND
+        ck = (domain, descriptor.entries)
+        ce = self._stems.get(ck)
+        if ce is None:
+            if len(self._stems) >= self._stem_cap:
+                # Rare full reset beats per-entry LRU bookkeeping on
+                # the hot path; regeneration is just the uncached cost.
+                self._stems.clear()
+            parts = [self.prefix, domain, "_"]
+            for entry in descriptor.entries:
+                parts.append(entry.key)
+                parts.append("_")
+                parts.append(entry.value)
+                parts.append("_")
+            # [stem, (last_window, last_CacheKey)] — the finished
+            # CacheKey is cached per window, so a hot descriptor costs
+            # one dict hit + one comparison until its window rolls.
+            ce = self._stems[ck] = ["".join(parts), None]
+        pair = ce[1]  # ONE atomic read: window and key travel together
+        if (
+            pair is not None
+            and pair[0] == window
+            and pair[1].per_second == per_second
+        ):
+            return pair[1]
+        out = CacheKey(ce[0] + str(window), per_second)
+        # Single-slot tuple swap: a concurrent reader sees either the
+        # old (window, key) pair or the new one, never a mix — two
+        # threads straddling a window rollover each get the key for
+        # THEIR window.
+        ce[1] = (window, out)
+        return out
