@@ -36,6 +36,28 @@ _LOG_LEVELS = {
 }
 
 
+def _make_engine(s: Settings, sharded: bool, num_slots: int):
+    """One construction site for counter engines (single-chip or the
+    bank-sharded mesh) so every backend branch shares the tuning
+    knobs."""
+    if sharded:
+        from .parallel import ShardedCounterEngine, make_mesh
+
+        return ShardedCounterEngine(
+            make_mesh(),
+            num_slots=num_slots,
+            near_ratio=s.near_limit_ratio,
+            buckets=tuple(s.tpu_batch_buckets),
+        )
+    from .backends.engine import CounterEngine
+
+    return CounterEngine(
+        num_slots=num_slots,
+        near_ratio=s.near_limit_ratio,
+        buckets=tuple(s.tpu_batch_buckets),
+    )
+
+
 def create_limiter(s: Settings, stats_manager: Manager, local_cache, time_source):
     """BackendType switch (reference runner.go:50-74)."""
     backend = s.backend_type.lower()
@@ -49,18 +71,16 @@ def create_limiter(s: Settings, stats_manager: Manager, local_cache, time_source
             cache_key_prefix=s.cache_key_prefix,
             expiration_jitter_max_seconds=s.expiration_jitter_max_seconds,
         )
-    if backend == "tpu-write-behind":
+    if backend in ("tpu-write-behind", "tpu-sharded-write-behind"):
         # Memcached-mode analog: decide on host, commit async
         # (reference memcached/cache_impl.go:58-174; see
-        # backends/write_behind.py for the envelope).
-        from .backends.engine import CounterEngine
+        # backends/write_behind.py for the envelope).  The engine under
+        # it is orthogonal: single-chip or the bank-sharded mesh.
         from .backends.write_behind import WriteBehindRateLimitCache
 
         return WriteBehindRateLimitCache(
-            CounterEngine(
-                num_slots=s.tpu_num_slots,
-                near_ratio=s.near_limit_ratio,
-                buckets=tuple(s.tpu_batch_buckets),
+            _make_engine(
+                s, backend == "tpu-sharded-write-behind", s.tpu_num_slots
             ),
             time_source=time_source,
             local_cache=local_cache,
@@ -72,46 +92,15 @@ def create_limiter(s: Settings, stats_manager: Manager, local_cache, time_source
             pipeline_depth=s.tpu_pipeline_depth,
         )
     if backend in ("tpu", "tpu-sharded"):
-        from .backends.engine import CounterEngine
         from .backends.tpu_cache import TpuRateLimitCache
 
-        if backend == "tpu-sharded":
-            import jax
-
-            from .parallel import ShardedCounterEngine, make_mesh
-
-            mesh = make_mesh()
-            engine = ShardedCounterEngine(
-                mesh,
-                num_slots=s.tpu_num_slots,
-                near_ratio=s.near_limit_ratio,
-                buckets=tuple(s.tpu_batch_buckets),
-            )
-            per_second_engine = (
-                ShardedCounterEngine(
-                    make_mesh(),
-                    num_slots=s.tpu_per_second_num_slots,
-                    near_ratio=s.near_limit_ratio,
-                    buckets=tuple(s.tpu_batch_buckets),
-                )
-                if s.tpu_per_second
-                else None
-            )
-        else:
-            engine = CounterEngine(
-                num_slots=s.tpu_num_slots,
-                near_ratio=s.near_limit_ratio,
-                buckets=tuple(s.tpu_batch_buckets),
-            )
-            per_second_engine = (
-                CounterEngine(
-                    num_slots=s.tpu_per_second_num_slots,
-                    near_ratio=s.near_limit_ratio,
-                    buckets=tuple(s.tpu_batch_buckets),
-                )
-                if s.tpu_per_second
-                else None
-            )
+        sharded = backend == "tpu-sharded"
+        engine = _make_engine(s, sharded, s.tpu_num_slots)
+        per_second_engine = (
+            _make_engine(s, sharded, s.tpu_per_second_num_slots)
+            if s.tpu_per_second
+            else None
+        )
         return TpuRateLimitCache(
             engine,
             time_source=time_source,
@@ -157,6 +146,19 @@ class Runner:
                 else "%(asctime)s %(levelname)s %(name)s %(message)s"
             ),
         )
+
+        if s.tpu_compile_cache_dir:
+            # Must land before the first jit compile (engine creation
+            # below): restarts and fleet replicas sharing the dir skip
+            # recompiling every (bucket, dtype) serving kernel.
+            import jax
+
+            jax.config.update(
+                "jax_compilation_cache_dir", s.tpu_compile_cache_dir
+            )
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0
+            )
 
         from .server.health import HealthChecker
         from .server.grpc_server import create_grpc_server

@@ -122,7 +122,8 @@ class Settings:
     near_limit_ratio: float = 0.8
     cache_key_prefix: str = ""
     # reference default "redis"; ours: tpu | tpu-sharded |
-    # tpu-write-behind (memcached-mode async commits) | memory
+    # tpu-write-behind | tpu-sharded-write-behind (memcached-mode
+    # async commits, single-chip or mesh engine) | memory
     backend_type: str = "tpu"
 
     # Custom response headers (settings.go:53-59).
@@ -162,6 +163,11 @@ class Settings:
     # reference delegates to Redis durability; empty = disabled).
     tpu_checkpoint_dir: str = ""
     tpu_checkpoint_interval_s: float = 30.0
+    # Persistent XLA compilation cache: restarts (and every replica of
+    # a fleet sharing the dir) skip recompiling the serving kernels —
+    # warmup drops from ~minutes of compiles to cache reads.  Empty =
+    # disabled.
+    tpu_compile_cache_dir: str = ""
 
     # Global shadow mode (settings.go:105).
     global_shadow_mode: bool = False
@@ -219,6 +225,7 @@ def new_settings() -> Settings:
         tpu_warmup=_env_bool("TPU_WARMUP", False),
         tpu_checkpoint_dir=_env_str("TPU_CHECKPOINT_DIR", ""),
         tpu_checkpoint_interval_s=_env_float("TPU_CHECKPOINT_INTERVAL_S", 30.0),
+        tpu_compile_cache_dir=_env_str("TPU_COMPILE_CACHE_DIR", ""),
         global_shadow_mode=_env_bool("SHADOW_MODE", False),
     )
     return s

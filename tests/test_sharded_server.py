@@ -136,3 +136,96 @@ def test_per_second_unit_on_sharded_backend(runner):
         for _ in range(3)
     ]
     assert codes == [OK, OK, OVER]
+
+
+def test_sharded_write_behind_backend(tmp_path_factory):
+    """BACKEND_TYPE=tpu-sharded-write-behind composes the async host-
+    decide mode with the bank-sharded mesh engine: wire-exact limit
+    enforcement, async commits landing on the sharded table."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    root = tmp_path_factory.mktemp("shwb-runtime")
+    config_dir = root / "ratelimit" / "config"
+    config_dir.mkdir(parents=True)
+    (config_dir / "sh.yaml").write_text(YAML)
+    r = Runner(
+        Settings(
+            host="127.0.0.1",
+            port=0,
+            grpc_host="127.0.0.1",
+            grpc_port=0,
+            debug_host="127.0.0.1",
+            debug_port=0,
+            use_statsd=False,
+            backend_type="tpu-sharded-write-behind",
+            tpu_num_slots=1 << 10,
+            tpu_batch_window_us=200,
+            tpu_batch_buckets=[8, 32],
+            runtime_path=str(root),
+            runtime_subdirectory="ratelimit",
+            local_cache_size_in_bytes=0,
+            expiration_jitter_max_seconds=0,
+        )
+    )
+    r.start()
+    try:
+        from ratelimit_tpu.parallel import ShardedCounterEngine
+
+        assert isinstance(r.cache.engine, ShardedCounterEngine)
+        OK = rls_pb2.RateLimitResponse.OK
+        OVER = rls_pb2.RateLimitResponse.OVER_LIMIT
+        codes = [
+            _call(r, _request([("limited", "wbmesh")])).overall_code
+            for _ in range(6)
+        ]
+        assert codes == [OK] * 4 + [OVER] * 2
+        r.cache.flush()
+        assert int(r.cache.engine.export_counts().sum()) >= 6
+    finally:
+        r.stop()
+
+
+def test_compile_cache_dir_populated(tmp_path_factory, monkeypatch):
+    """TPU_COMPILE_CACHE_DIR persists compiled serving kernels so
+    restarts skip XLA recompilation."""
+    import jax
+
+    cache_dir = str(tmp_path_factory.mktemp("xla-cache"))
+    root = tmp_path_factory.mktemp("cc-runtime")
+    config_dir = root / "ratelimit" / "config"
+    config_dir.mkdir(parents=True)
+    (config_dir / "sh.yaml").write_text(YAML)
+    r = Runner(
+        Settings(
+            host="127.0.0.1",
+            port=0,
+            grpc_host="127.0.0.1",
+            grpc_port=0,
+            debug_host="127.0.0.1",
+            debug_port=0,
+            use_statsd=False,
+            backend_type="tpu",
+            tpu_num_slots=1 << 10,
+            tpu_batch_window_us=200,
+            tpu_batch_buckets=[8],
+            runtime_path=str(root),
+            runtime_subdirectory="ratelimit",
+            local_cache_size_in_bytes=0,
+            expiration_jitter_max_seconds=0,
+            tpu_compile_cache_dir=cache_dir,
+        )
+    )
+    r.start()
+    try:
+        resp = _call(r, _request([("limited", "cc")]))
+        assert resp.overall_code == rls_pb2.RateLimitResponse.OK
+        import os
+
+        entries = os.listdir(cache_dir)
+        assert entries, "compile cache dir is empty after serving"
+    finally:
+        r.stop()
+        # Don't leak the config change into other tests.
+        jax.config.update("jax_compilation_cache_dir", None)
