@@ -302,7 +302,7 @@ class TpuRateLimitCache:
             d = self._dispatchers.get(id(engine))
             if d is not None:
                 store.gauge_fn(
-                    base + ".dispatch_queue", lambda dd=d: dd._q.qsize()
+                    base + ".dispatch_queue", lambda dd=d: dd.queue_depth()
                 )
 
     def engines(self):

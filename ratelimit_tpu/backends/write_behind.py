@@ -397,7 +397,7 @@ class WriteBehindRateLimitCache:
             base + ".num_slots", lambda: self.engine.model.num_slots
         )
         store.gauge_fn(
-            base + ".dispatch_queue", lambda: self._dispatcher._q.qsize()
+            base + ".dispatch_queue", lambda: self._dispatcher.queue_depth()
         )
         store.gauge_fn(
             scope + ".host_view_keys", lambda: len(self._view)

@@ -90,7 +90,9 @@ def test_collector_death_fast_fails_everything():
         engine, batch_window_us=100, unhealthy_after=3, on_state=log
     )
     # Poison object: not a WorkItem/token, crashes the collector loop.
-    d._q.put(object())
+    with d._buf_cv:
+        d._buf.append(object())
+        d._buf_cv.notify()
     deadline = time.monotonic() + 5
     while d.dead is None and time.monotonic() < deadline:
         time.sleep(0.01)
@@ -138,7 +140,9 @@ def test_cache_surfaces_dead_dispatcher_as_cache_error():
         assert cache.do_limit(req, [rule])[0] is not None  # alive
 
         d = next(iter(cache._dispatchers.values()))
-        d._q.put(object())  # kill the collector
+        with d._buf_cv:  # kill the collector with a poison entry
+            d._buf.append(object())
+            d._buf_cv.notify()
         deadline = time.monotonic() + 5
         while d.dead is None and time.monotonic() < deadline:
             time.sleep(0.01)

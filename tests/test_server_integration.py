@@ -249,7 +249,9 @@ def test_backend_death_flips_health_and_fast_fails(tmp_path):
 
         # Kill the collector with a poison queue entry.
         d = next(iter(r.cache._dispatchers.values()))
-        d._q.put(object())
+        with d._buf_cv:
+            d._buf.append(object())
+            d._buf_cv.notify()
         deadline = _time.monotonic() + 5
         while d.dead is None and _time.monotonic() < deadline:
             _time.sleep(0.01)

@@ -227,5 +227,6 @@ def test_compile_cache_dir_populated(tmp_path_factory, monkeypatch):
         assert entries, "compile cache dir is empty after serving"
     finally:
         r.stop()
-        # Don't leak the config change into other tests.
+        # Don't leak the config changes into other tests.
         jax.config.update("jax_compilation_cache_dir", None)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
