@@ -31,6 +31,14 @@ class LocalCache:
         self._entries: "OrderedDict[str, float]" = OrderedDict()
         self._lock = threading.Lock()
         self._clock = clock or time.monotonic
+        # freecache-parity counters (reference local_cache_stats.go):
+        # all mutate under _lock, read lock-free by the stats gauges
+        # (plain int reads are atomic under the GIL).
+        self.hit_count = 0
+        self.miss_count = 0
+        self.expired_count = 0
+        self.evacuate_count = 0
+        self.overwrite_count = 0
 
     def contains(self, key: str) -> bool:
         """True if `key` is cached and unexpired
@@ -39,10 +47,14 @@ class LocalCache:
         with self._lock:
             expiry = self._entries.get(key)
             if expiry is None:
+                self.miss_count += 1
                 return False
             if expiry <= now:
                 del self._entries[key]
+                self.expired_count += 1
+                self.miss_count += 1
                 return False
+            self.hit_count += 1
             return True
 
     def set(self, key: str, ttl_seconds: int) -> None:
@@ -50,10 +62,13 @@ class LocalCache:
         base_limiter.go:103-115)."""
         now = self._clock()
         with self._lock:
+            if key in self._entries:
+                self.overwrite_count += 1
             self._entries[key] = now + ttl_seconds
             self._entries.move_to_end(key)
             while len(self._entries) > self.max_entries:
                 self._entries.popitem(last=False)
+                self.evacuate_count += 1
 
     def __len__(self) -> int:
         with self._lock:
@@ -62,5 +77,18 @@ class LocalCache:
     def register_stats(self, store: StatsStore, scope: str = "ratelimit.localcache") -> None:
         """Expose freecache-style gauges, re-read at every stats
         snapshot like the reference's StatGenerator (reference
-        src/limiter/local_cache_stats.go)."""
+        src/limiter/local_cache_stats.go: evacuate/expired/entry/hit/
+        miss/lookup/overwrite counts; averageAccessTime is a freecache
+        internal with no analog here and is omitted)."""
         store.gauge_fn(scope + ".entryCount", lambda: len(self))
+        store.gauge_fn(scope + ".hitCount", lambda: self.hit_count)
+        store.gauge_fn(scope + ".missCount", lambda: self.miss_count)
+        store.gauge_fn(
+            scope + ".lookupCount",
+            lambda: self.hit_count + self.miss_count,
+        )
+        store.gauge_fn(scope + ".expiredCount", lambda: self.expired_count)
+        store.gauge_fn(scope + ".evacuateCount", lambda: self.evacuate_count)
+        store.gauge_fn(
+            scope + ".overwriteCount", lambda: self.overwrite_count
+        )

@@ -147,3 +147,31 @@ def test_local_cache_live_gauge():
     assert store.gauges()["ratelimit.localcache.entryCount"] == 0
     cache.set("k", 100)
     assert store.gauges()["ratelimit.localcache.entryCount"] == 1
+
+
+def test_local_cache_freecache_parity_gauges():
+    """The full freecache gauge set (reference local_cache_stats.go):
+    hit/miss/lookup/expired/evacuate/overwrite/entry counts."""
+    from ratelimit_tpu.limiter.local_cache import LocalCache
+    from ratelimit_tpu.stats.manager import StatsStore
+
+    clock = [0.0]
+    lc = LocalCache(64 * 2, clock=lambda: clock[0])  # 2 entries max
+    assert not lc.contains("a")  # miss
+    lc.set("a", 10)
+    assert lc.contains("a")  # hit
+    lc.set("a", 10)  # overwrite
+    lc.set("b", 10)
+    lc.set("c", 10)  # evacuates the FIFO head
+    clock[0] = 11.0
+    assert not lc.contains("c")  # expired -> miss
+    store = StatsStore()
+    lc.register_stats(store)
+    snap = store.snapshot()
+    assert snap["ratelimit.localcache.hitCount"] == 1
+    assert snap["ratelimit.localcache.missCount"] == 2
+    assert snap["ratelimit.localcache.lookupCount"] == 3
+    assert snap["ratelimit.localcache.expiredCount"] == 1
+    assert snap["ratelimit.localcache.evacuateCount"] == 1
+    assert snap["ratelimit.localcache.overwriteCount"] == 1
+    assert snap["ratelimit.localcache.entryCount"] == 1  # only b lives

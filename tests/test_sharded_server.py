@@ -193,6 +193,7 @@ def test_compile_cache_dir_populated(tmp_path_factory, monkeypatch):
     import jax
 
     cache_dir = str(tmp_path_factory.mktemp("xla-cache"))
+    prev_min_compile = jax.config.jax_persistent_cache_min_compile_time_secs
     root = tmp_path_factory.mktemp("cc-runtime")
     config_dir = root / "ratelimit" / "config"
     config_dir.mkdir(parents=True)
@@ -229,4 +230,6 @@ def test_compile_cache_dir_populated(tmp_path_factory, monkeypatch):
         r.stop()
         # Don't leak the config changes into other tests.
         jax.config.update("jax_compilation_cache_dir", None)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", prev_min_compile
+        )
