@@ -70,6 +70,8 @@ def _signatures(lib: ctypes.CDLL) -> None:
     lib.sk_len.argtypes = [ctypes.c_void_p]
     lib.sk_evictions.restype = i64
     lib.sk_evictions.argtypes = [ctypes.c_void_p]
+    lib.sk_arena_bytes.restype = i64
+    lib.sk_arena_bytes.argtypes = [ctypes.c_void_p]
     lib.sk_gc.restype = i64
     lib.sk_gc.argtypes = [ctypes.c_void_p, i64]
     lib.sk_begin_batch.argtypes = [ctypes.c_void_p]
@@ -159,6 +161,11 @@ class NativeSlotTable:
     @property
     def evictions(self) -> int:
         return int(self._lib.sk_evictions(self._handle))
+
+    @property
+    def arena_bytes(self) -> int:
+        """Key-arena footprint incl. uncompacted tombstone bytes."""
+        return int(self._lib.sk_arena_bytes(self._handle))
 
     def gc(self, now: int) -> int:
         return int(self._lib.sk_gc(self._handle, int(now)))

@@ -214,3 +214,29 @@ def test_fused_assign_dedup_exhaustion():
             np.ones(3, np.uint32),
             np.ones(3, np.uint32),
         )
+
+
+def test_steady_state_churn_compacts_arena():
+    """Review finding (round 3): steady-state expiry churn (gc
+    tombstones a key, the next window reinserts it) reuses tombstone
+    probe slots, so the load-based rehash trigger never fires — the
+    dead-byte trigger must compact the arena or it grows without
+    bound (and would eventually wrap the u32 key offsets)."""
+    t = native_slot_table.NativeSlotTable(4096)
+    keys = [f"churnkey_with_a_realistic_length_{i:05d}" for i in range(2048)]
+    key_bytes = sum(len(k) for k in keys)
+    peak = 0
+    for window in range(40):
+        now = window * 100
+        expiries = [now + 50] * len(keys)
+        slots, _ = t.assign_batch(keys, now, expiries)
+        assert len(set(map(int, slots))) == len(keys)
+        t.gc(now + 60)  # whole window expires
+        assert len(t) == 0
+        peak = max(peak, t.arena_bytes)
+    # 40 windows x ~78KB of keys: unbounded growth would reach
+    # ~40x key_bytes (~3MB).  The compaction trigger (dead > 1MB and
+    # dead > half the arena) caps the peak around the 1MB threshold —
+    # ~14x key_bytes here — so anything under 20x proves compaction
+    # fired and bounded the arena.
+    assert peak < 20 * key_bytes, (peak, key_bytes)
