@@ -1,12 +1,11 @@
 #!/bin/sh
 # Subprocess-level black-box e2e: launches the real server as a child
 # process (`python -m ratelimit_tpu.runner` with the example config)
-# and runs the three reference scenarios against its live HTTP/gRPC/
-# debug surfaces.  This is the docker-less equivalent of the compose
-# stack (run-all.sh): same scenarios — happy path, 429 after quota,
-# shadow mode never blocks — minus the Envoy hop (no envoy binary in
-# this environment; scripts-local/ hits the service surfaces the
-# Envoy rate_limit filter would call).
+# and runs every scenario in scripts-local/ against live surfaces.
+# 01-03 are the compose stack's scenarios (run-all.sh: happy path, 429
+# after quota, shadow mode never blocks) minus the Envoy hop (no envoy
+# binary here); 04 (checkpoint/restart survival) is local-only — it
+# launches its own server generations.
 #
 # Usage:  sh integration-test/run-local.sh     (or `make e2e-local`,
 # which records the transcript in integration-test/results/).
