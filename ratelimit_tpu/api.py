@@ -42,7 +42,7 @@ class Code(enum.IntEnum):
     OVER_LIMIT = 2
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class Entry:
     """RateLimitDescriptor.Entry: one key[/value] pair."""
 
@@ -50,7 +50,7 @@ class Entry:
     value: str = ""
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class LimitOverride:
     """RateLimitDescriptor.RateLimitOverride: a request-supplied limit.
 
@@ -62,7 +62,7 @@ class LimitOverride:
     unit: Unit
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class Descriptor:
     """RateLimitDescriptor: an ordered tuple of entries plus an
     optional request-supplied limit override."""
@@ -75,7 +75,7 @@ class Descriptor:
         return Descriptor(tuple(Entry(k, v) for k, v in pairs), limit)
 
 
-@dataclass
+@dataclass(slots=True)
 class RateLimitRequest:
     """RateLimitRequest: (domain, descriptors, hits_addend)."""
 
@@ -84,7 +84,7 @@ class RateLimitRequest:
     hits_addend: int = 0
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class RateLimit:
     """RateLimitResponse.RateLimit: the limit actually applied."""
 
@@ -92,7 +92,7 @@ class RateLimit:
     unit: Unit
 
 
-@dataclass
+@dataclass(slots=True)
 class DescriptorStatus:
     """RateLimitResponse.DescriptorStatus for one descriptor."""
 
@@ -105,7 +105,7 @@ class DescriptorStatus:
     duration_until_reset: Optional[int] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class HeaderValue:
     """config.core.v3.HeaderValue."""
 

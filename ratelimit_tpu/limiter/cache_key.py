@@ -21,7 +21,7 @@ from ..config import RateLimitRule
 from ..utils.time import window_start
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class CacheKey:
     key: str
     # True when the limit's unit is SECOND; routes to the dedicated
