@@ -82,6 +82,13 @@ class _Dedup:
     fresh: np.ndarray  # bool[g] any lane fresh
     limit_max: np.ndarray  # uint32[g] max limit in group (saturation cap)
 
+    def totals_u32(self) -> np.ndarray:
+        """Group totals CLAMPED (not wrapped) into the saturating u32
+        counter domain the device runs in — a past-u32 total makes the
+        device saturate the counter at u32 max, which the host
+        reconstruction treats as fully-over (_decide_host)."""
+        return np.minimum(self.totals, 0xFFFFFFFF).astype(np.uint32)
+
 
 def _dedup_chunk(
     slots: np.ndarray,
@@ -148,20 +155,27 @@ def _decide_host(
     The device returned one (possibly saturated) `after` per UNIQUE
     slot; per-lane values are rebuilt as
         before_lane = (after_group - group_total) + lane_prefix
-    which is exact even under saturation: the narrow readback clamps at
-    group-max-limit + group-total, and clamping only engages when the
-    true group 'before' exceeds the group-max limit — in which case
-    every lane is in the fully-over branch, whose outputs depend only
-    on before >= limit (still true for the clamped value).
+    in exact uint64 arithmetic — the device counter is SATURATING (it
+    clamps at u32 max instead of wrapping, see update_unique), so the
+    subtraction never underflows in the unsaturated case.  Two
+    saturation regimes:
 
-    Reconstruction runs in uint32 modular arithmetic — the device
-    counter domain.  The device was handed the group total wrapped to
-    uint32, so the host must subtract (and add prefixes) with the same
-    wrap, or a batch whose same-slot hits sum past 2^32 would yield
-    negative befores here while the device wrapped.  Counters
-    semantically wrap at 2^32 (limits are uint32, far below)."""
+    - narrow readback clamp (at group-max-limit + group-total): only
+      engages when the true group 'before' exceeds the group-max
+      limit, leaving reconstructed before == limit — every lane lands
+      in the fully-over branch, whose outputs depend only on
+      before >= limit (the step_counters_compact argument);
+    - u32-max counter saturation (a key lapped past 2^32 hits in one
+      window): after_group reads back as u32 max; every lane is
+      treated as fully-over — decision-exact for every limit BELOW
+      u32 max (stat attribution rounds toward over_limit for this
+      astronomically hot key).  At the degenerate limit == u32 max
+      the saturated counter reads exactly at-limit and keeps
+      answering OK — the counter cannot count higher, which is also
+      where a limit that large stops being a limit."""
     from ..limiter.base import decide_batch
 
+    U32_MAX = np.uint64(0xFFFFFFFF)
     count = len(hits_u32)
     hits = hits_u32.astype(np.int64)
     if dedup is None:  # afters already per-lane (general device path)
@@ -169,12 +183,19 @@ def _decide_host(
         befores = afters - hits
     else:
         g = len(dedup.uniq_slots)
-        afters_g = afters_padded[:g].astype(np.uint32)
-        before_g = afters_g - dedup.totals.astype(np.uint32)  # modular
-        befores_u32 = before_g[dedup.inv] + dedup.prefix.astype(np.uint32)
-        afters_u32 = befores_u32 + hits_u32.astype(np.uint32)
-        befores = befores_u32.astype(np.int64)
-        afters = afters_u32.astype(np.int64)
+        afters_g = afters_padded[:g].astype(np.uint64)
+        saturated = afters_g >= U32_MAX
+        before_g = np.where(
+            saturated,
+            U32_MAX,
+            afters_g - np.minimum(dedup.totals, afters_g),
+        )
+        befores_u64 = before_g[dedup.inv] + dedup.prefix
+        afters_u64 = np.minimum(
+            befores_u64 + hits_u32.astype(np.uint64), U32_MAX
+        )
+        befores = np.minimum(befores_u64, U32_MAX).astype(np.int64)
+        afters = afters_u64.astype(np.int64)
     d = decide_batch(
         limits=limits_u32,
         befores=befores,
@@ -207,15 +228,27 @@ class CounterEngine:
         model=None,
         native_table: Optional[bool] = None,
     ):
-        """`model` defaults to a single-chip FixedWindowModel; pass any
-        object with the same surface (init_state/step_counters/
-        num_slots/near_ratio) — e.g. parallel.ShardedFixedWindowModel —
-        to run the same host orchestration over a different device
-        layout.  `native_table`: None = use the C++ slot table when it
-        builds/loads, True = require it, False = pure Python."""
+        """`model` defaults to a single-chip FixedWindowModel.  A
+        custom model must provide a SATURATING unique-slot serving
+        path (step_counters_unique_packed or step_counters_unique +
+        step_counters_unique_compact) — for mesh models use
+        parallel.ShardedCounterEngine, which overrides the device
+        submit with its routed path.  `native_table`: None = use the
+        C++ slot table when it builds/loads, True = require it,
+        False = pure Python."""
         self.model = model if model is not None else FixedWindowModel(
             num_slots, near_ratio
         )
+        if type(self)._device_submit is CounterEngine._device_submit and not (
+            hasattr(self.model, "step_counters_unique_packed")
+            or hasattr(self.model, "step_counters_unique")
+        ):
+            raise TypeError(
+                "model must provide a saturating unique-slot serving "
+                "path (step_counters_unique[_packed]); the modular "
+                "update() path is not safe for serving — for mesh "
+                "models use parallel.ShardedCounterEngine"
+            )
         self._table_cls = _pick_table_cls(native_table)
         self.slot_table = self._table_cls(self.model.num_slots)
         self.buckets = tuple(sorted(buckets))
@@ -431,11 +464,11 @@ class CounterEngine:
         g = len(dedup.uniq_slots)
         padded = self._bucket(g)
         ns = self.model.num_slots
-        # Dtype choice must use the UNWRAPPED uint64 totals: a group
-        # whose hits sum past 2^32 wraps the device total to a small
-        # value, and the clamped narrow readback's exactness argument
-        # does not hold for wrapped groups — they must ride the raw
-        # uint32 path, where modular reconstruction is exact.
+        # Dtype choice uses the UNWRAPPED uint64 totals; totals past
+        # u32 max are CLAMPED for the device (not wrapped), matching
+        # the saturating counter arithmetic — the device stores u32
+        # max and the host treats the group as fully-over
+        # (_decide_host's saturation branch).
         cap = int(dedup.totals.max(initial=0)) + int(
             dedup.limit_max.max(initial=1)
         )
@@ -460,7 +493,7 @@ class CounterEngine:
             # so the unique_indices scatter promise holds.
             pk = np.empty((4, padded), dtype=np.int32)
             pk[0, :g] = dedup.uniq_slots
-            pk[1, :g] = dedup.totals.astype(np.uint32).view(np.int32)
+            pk[1, :g] = dedup.totals_u32().view(np.int32)
             pk[2, :g] = dedup.limit_max.view(np.int32)
             pk[3, :g] = dedup.fresh
             if padded > g:
@@ -473,15 +506,19 @@ class CounterEngine:
             )
             return afters_dev, None
 
-        # Generic-model path (any object with the documented surface):
-        # five separate leaves, unique step when available.
+        # Unpacked unique path (models with step_counters_unique but
+        # no packed entry): five separate leaves.  There is NO modular
+        # fallback here — serving requires a saturating unique path
+        # (update()'s scatter-add wraps, which would reset enforcement
+        # for lapped keys; see update_unique), so models without one
+        # are rejected at engine construction.
         sl = np.arange(ns, ns + padded, dtype=np.int64).astype(np.int32)
         hi = np.zeros(padded, dtype=np.uint32)
         li = np.ones(padded, dtype=np.uint32)
         fr = np.zeros(padded, dtype=bool)
         sh = np.zeros(padded, dtype=bool)
         sl[:g] = dedup.uniq_slots
-        hi[:g] = dedup.totals.astype(np.uint32)  # u32 counter domain
+        hi[:g] = dedup.totals_u32()
         li[:g] = dedup.limit_max
         fr[:g] = dedup.fresh
 
@@ -492,21 +529,14 @@ class CounterEngine:
             fresh=jax.numpy.asarray(fr),
             shadow=jax.numpy.asarray(sh),
         )
-        unique_ok = hasattr(self.model, "step_counters_unique")
         if dt:
-            fn = (
-                self.model.step_counters_unique_compact
-                if unique_ok
-                else self.model.step_counters_compact
+            self._counts, afters_dev = self.model.step_counters_unique_compact(
+                self._counts, dt, device_batch
             )
-            self._counts, afters_dev = fn(self._counts, dt, device_batch)
         else:
-            fn = (
-                self.model.step_counters_unique
-                if unique_ok
-                else self.model.step_counters
+            self._counts, afters_dev = self.model.step_counters_unique(
+                self._counts, device_batch
             )
-            self._counts, afters_dev = fn(self._counts, device_batch)
         return afters_dev, None
 
     def reset(self) -> None:

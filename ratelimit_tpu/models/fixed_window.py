@@ -228,7 +228,17 @@ class FixedWindowModel:
             before = counts.at[slots].get(mode="fill", fill_value=0)
 
         before = jnp.where(batch.fresh, jnp.uint32(0), before)
+        # SATURATING add, not modular: a wrapped counter would RESET
+        # enforcement — two hits_addend = 2^32-1 requests would lap
+        # the window.  The reference is immune because Redis counters
+        # are int64; saturation gives the same safe direction (a
+        # lapped key stays over-limit until its window resets).
+        # u32-native wrap detect (JAX truncates u64 without x64 mode):
+        # one u32 add wraps at most once, so after < before <=> wrap.
         afters = before + hits
+        afters = jnp.where(
+            afters < before, jnp.uint32(0xFFFFFFFF), afters
+        )
         counts = counts.at[slots].set(
             afters, mode="drop", unique_indices=True
         )
@@ -238,7 +248,15 @@ class FixedWindowModel:
         self, counts: jax.Array, batch: DeviceBatch
     ) -> Tuple[jax.Array, jax.Array]:
         """Pure counter update body: zero fresh slots, gather 'before',
-        in-batch pipeline-order prefix, scatter-add; returns afters."""
+        in-batch pipeline-order prefix, scatter-add; returns afters.
+
+        NOTE: this general (duplicate-tolerant) path keeps MODULAR u32
+        arithmetic — scatter-add has no saturating form.  It is
+        unreachable from serving (CounterEngine rejects models without
+        a saturating unique path at construction, and its device
+        submit only calls the unique entries); it exists for parity
+        tests and the replicated forward/step paths at small values.
+        """
         s = self.num_slots
         slots = batch.slots
         hits = batch.hits.astype(jnp.uint32)  # counters are uint32

@@ -250,7 +250,13 @@ class ShardedFixedWindowModel:
             before = row.at[slots].get(mode="fill", fill_value=0)
 
         before = jnp.where(fresh, jnp.uint32(0), before)
+        # Saturating add, mirroring FixedWindowModel.update_unique
+        # (u32-native wrap detect; a modular wrap would reset
+        # enforcement for lapped keys).
         afters = before + hits
+        afters = jnp.where(
+            afters < before, jnp.uint32(0xFFFFFFFF), afters
+        )
         row = row.at[slots].set(afters, mode="drop", unique_indices=True)
         return row[None, :], afters[None, :]
 
@@ -332,7 +338,8 @@ class ShardedCounterEngine(CounterEngine):
         nb = m.num_banks
         uniq = dedup.uniq_slots
         g = len(uniq)
-        totals32 = dedup.totals.astype(np.uint32)
+        # Clamp (not wrap) into the saturating u32 counter domain.
+        totals32 = np.minimum(dedup.totals, 0xFFFFFFFF).astype(np.uint32)
 
         valid = (uniq >= 0) & (uniq < m.num_slots)
         vi = np.nonzero(valid)[0]
@@ -362,8 +369,8 @@ class ShardedCounterEngine(CounterEngine):
         pk[banks, 3, pos] = dedup.fresh[vi]
 
         # Unwrapped uint64 totals for the dtype choice (see
-        # CounterEngine._device_submit): wrapped groups must take the
-        # raw uint32 path, never the clamped narrow readback.
+        # CounterEngine._device_submit): clamped-total groups take the
+        # raw uint32 path, never the narrow readback.
         cap_val = int(dedup.totals[vi].max(initial=0)) + int(
             dedup.limit_max[vi].max(initial=1)
         )

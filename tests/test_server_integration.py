@@ -342,3 +342,49 @@ def test_grpc_hits_addend_wire_level(runner):
     # Third request: fully over.
     resp = _grpc_call(runner, _request("basic", [("key1", "wirehits")], hits=1))
     assert resp.overall_code == rls_pb2.RateLimitResponse.OVER_LIMIT
+
+
+def test_json_endpoint_survives_hostile_bodies(runner):
+    """Malformed/hostile bodies must map to 4xx/5xx without harming
+    the server (reference server_impl_test.go:44-85 400-path, widened:
+    junk bytes, invalid utf-8, wrong shapes, huge-ish payloads)."""
+    hostile = [
+        b"not json {",
+        b"\xff\xfe\x00\x01binary",
+        b"{}",  # missing domain -> service error
+        b'{"domain": 42}',
+        b'{"descriptors": "nope", "domain": "basic"}',
+        b'{"domain":"basic","descriptors":[{"entries":"x"}]}',
+        json.dumps(
+            {"domain": "basic", "descriptors": [{"entries": [{"key": "k" * 10000, "value": "v" * 10000}]}]}
+        ).encode(),
+        json.dumps(
+            {
+                "domain": "basic",
+                "descriptors": [
+                    {"entries": [{"key": f"k{i}", "value": f"v{i}"}]}
+                    for i in range(300)
+                ],
+            }
+        ).encode(),
+    ]
+    for body in hostile:
+        status, _ = _http(runner, "/json", body)
+        assert status in (200, 400, 429, 500), (status, body[:40])
+    # The server is still healthy and serving real traffic.
+    status, out = _http(runner, "/healthcheck")
+    assert (status, out) == (200, b"OK")
+    resp = _grpc_call(runner, _request("basic", [("key1", "afterfuzz")]))
+    assert resp.overall_code == rls_pb2.RateLimitResponse.OK
+
+
+def test_grpc_extreme_hits_addend(runner):
+    """hits_addend at the uint32 ceiling: one request exhausts any
+    limit, attribution never wraps negative, and the server survives."""
+    req = _request("basic", [("key1", "maxhits")], hits=0xFFFFFFFF)
+    resp = _grpc_call(runner, req)
+    assert resp.overall_code == rls_pb2.RateLimitResponse.OVER_LIMIT
+    assert resp.statuses[0].limit_remaining == 0
+    # Follow-up normal request on the same key: still over, sane.
+    resp = _grpc_call(runner, _request("basic", [("key1", "maxhits")]))
+    assert resp.overall_code == rls_pb2.RateLimitResponse.OVER_LIMIT

@@ -174,12 +174,13 @@ def test_engine_dedup_saturation_mixed_limits():
             )
 
 
-def test_dedup_group_total_past_uint32_stays_in_counter_domain():
-    """A batch whose same-slot hits sum past 2^32 must reconstruct
-    befores/afters in the device's uint32 modular domain — never
-    negative, and the table counter must equal the wrapped total
-    (round-3 advisor finding: the device wrapped while the host
-    subtracted the unwrapped uint64 total)."""
+def test_dedup_group_total_past_uint32_saturates_never_wraps():
+    """A batch whose same-slot hits sum past 2^32 SATURATES the
+    counter at u32 max instead of wrapping (round-3 hardening: a
+    wrapped counter would reset enforcement — two 2^32-1-hit requests
+    could lap the window; the reference is immune via int64 Redis
+    counters).  The group reads back saturated and every lane is
+    treated as fully-over."""
     e = CounterEngine(num_slots=NUM_SLOTS, buckets=(8,))
     half = np.uint32(0x8000_0000)
     hb = HostBatch(
@@ -193,23 +194,31 @@ def test_dedup_group_total_past_uint32_stays_in_counter_domain():
     assert (d.befores >= 0).all(), d.befores
     assert (d.afters >= 0).all(), d.afters
     assert (d.befores < 1 << 32).all() and (d.afters < 1 << 32).all()
-    # Pipeline order: lane0 sees before=0, after=2^31 (over the limit);
-    # lane1's after wraps to 0 — exactly what a uint32 counter does.
-    assert d.befores[0] == 0 and d.afters[0] == int(half)
-    assert d.befores[1] == int(half) and d.afters[1] == 0
-    # Partial-hit attribution: before=0 < limit, so over_limit counts
-    # after-limit (base_limiter.go:150-165 semantics).
-    assert int(d.over_limit[0]) == int(half) - 10
-    # The stored counter is the wrapped group total.
-    assert e.export_counts()[7] == 0
+    # Saturated group: both lanes OVER_LIMIT, never wrapped to OK.
+    assert (np.asarray(d.codes) == 2).all(), d.codes
+    assert (np.asarray(d.limit_remaining) == 0).all()
+    # The stored counter is pinned at u32 max: the NEXT request in the
+    # same window stays over (the wrap would have reset it to 0/OK).
+    assert e.export_counts()[7] == 0xFFFFFFFF
+    d2 = e.step(
+        HostBatch(
+            slots=np.array([7], dtype=np.int32),
+            hits=np.ones(1, dtype=np.uint32),
+            limits=np.full(1, 10, dtype=np.uint32),
+            fresh=np.zeros(1, dtype=bool),
+            shadow=np.zeros(1, dtype=bool),
+        )
+    )
+    assert int(d2.codes[0]) == 2, "saturated counter must stay over"
 
 
-def test_wrapped_group_rides_raw_readback_not_clamped():
-    """A wrapped group total must force the raw uint32 readback: the
-    wrapped hi (0 for a 2^32 total) would otherwise pick the uint8
-    clamped path, whose saturation argument breaks on a counter that
-    already holds a value — a truly over-limit lane would come back
-    OK (round-3 review finding)."""
+def test_huge_group_total_rides_raw_readback_and_saturates():
+    """A past-u32 group total must force the raw uint32 readback (a
+    wrapped/clamped hi would otherwise pick the uint8 clamped path,
+    whose exactness argument breaks) and saturate the counter: both
+    lanes stay fully over and the NEXT request stays over too
+    (round-3 hardening; previously the counter wrapped back to its
+    seed value)."""
     e = CounterEngine(num_slots=NUM_SLOTS, buckets=(8,))
     half = np.uint32(0x8000_0000)
 
@@ -225,15 +234,14 @@ def test_wrapped_group_rides_raw_readback_not_clamped():
 
     # Seed the counter to 200 (limit 10: already far over).
     e.step(mk([7], [200], [10]))
-    # Two same-slot lanes summing to exactly 2^32 (wrapped total 0).
+    # Two same-slot lanes summing to exactly 2^32 (clamped to u32 max).
     d = e.step(mk([7, 7], [half, half], [10, 10]))
-    # Device counter: (200 + 2^32) mod 2^32 = 200.
-    assert e.export_counts()[7] == 200
-    # Both lanes are fully over: before >= limit for each.
-    assert d.befores[0] == 200
-    assert d.afters[0] == 200 + int(half)
-    assert d.befores[1] == (200 + int(half)) % (1 << 32)
-    assert d.afters[1] == 200  # wrapped
+    # Saturating counter: pinned at u32 max, not wrapped back to 200.
+    assert e.export_counts()[7] == 0xFFFFFFFF
+    # Both lanes are fully over.
     assert (np.asarray(d.codes) == 2).all(), d.codes  # OVER_LIMIT
     assert int(d.over_limit[0]) == int(half)  # fully-over: all hits
     assert int(d.over_limit[1]) == int(half)
+    # And the key stays over afterwards.
+    d2 = e.step(mk([7], [1], [10]))
+    assert int(d2.codes[0]) == 2
