@@ -4,8 +4,9 @@
 # and runs every scenario in scripts-local/ against live surfaces.
 # 01-03 are the compose stack's scenarios (run-all.sh: happy path, 429
 # after quota, shadow mode never blocks) minus the Envoy hop (no envoy
-# binary here); 04 (checkpoint/restart survival) is local-only — it
-# launches its own server generations.
+# binary here); 04 (checkpoint/restart survival) and 05 (multi-replica
+# joint enforcement through the cluster proxy) are local-only and
+# launch their own server processes.
 #
 # Usage:  sh integration-test/run-local.sh     (or `make e2e-local`,
 # which records the transcript in integration-test/results/).

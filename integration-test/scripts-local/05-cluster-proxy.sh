@@ -1,0 +1,104 @@
+#!/bin/sh
+# Multi-replica joint enforcement (black-box): two replica server
+# processes + the stateless rendezvous front proxy; a 2/minute key
+# through the proxy is jointly enforced (docs/MULTI_REPLICA.md), and
+# the same key hits exactly one replica's counter.  Self-contained
+# like 04: own ports (1908x/19090), own env.
+set -e
+cd "$(dirname "$0")/../.."
+export JAX_PLATFORMS=cpu
+export PALLAS_AXON_POOL_IPS=
+
+# Stale-process guards: HTTP healthchecks for the replicas, raw TCP
+# probes for the gRPC-only ports (curl's HTTP probe cannot see a
+# stale gRPC listener) — a SIGKILLed prior run leaves all of them.
+for port in 19080 29080; do
+  if curl -s -o /dev/null "http://localhost:$port/healthcheck"; then
+    echo "port $port already serving — stop the stale server first"
+    exit 1
+  fi
+done
+for port in 19081 29081 19090; do
+  if "${PY:-python}" -c "import socket,sys; s=socket.socket(); s.settimeout(0.5); sys.exit(0 if s.connect_ex(('127.0.0.1',$port))==0 else 1)"; then
+    echo "gRPC port $port already bound — stop the stale process first"
+    exit 1
+  fi
+done
+
+RL=$(mktemp -d)
+mkdir -p "$RL/r1/ratelimit/config" "$RL/r2/ratelimit/config"
+cp examples/ratelimit/config/example.yaml "$RL/r1/ratelimit/config/"
+cp examples/ratelimit/config/example.yaml "$RL/r2/ratelimit/config/"
+PIDS=""
+cleanup() {
+  for p in $PIDS; do kill "$p" 2>/dev/null || true; done
+  for p in $PIDS; do wait "$p" 2>/dev/null || true; done
+  rm -rf "$RL"
+}
+trap cleanup EXIT
+
+RUNTIME_ROOT="$RL/r1" RUNTIME_SUBDIRECTORY=ratelimit \
+  PORT=19080 GRPC_PORT=19081 DEBUG_PORT=19070 TPU_NUM_SLOTS=65536 \
+  "${PY:-python}" -m ratelimit_tpu.runner >"$RL/r1.log" 2>&1 &
+PIDS="$PIDS $!"
+RUNTIME_ROOT="$RL/r2" RUNTIME_SUBDIRECTORY=ratelimit \
+  PORT=29080 GRPC_PORT=29081 DEBUG_PORT=29070 TPU_NUM_SLOTS=65536 \
+  "${PY:-python}" -m ratelimit_tpu.runner >"$RL/r2.log" 2>&1 &
+PIDS="$PIDS $!"
+
+up=0
+for i in $(seq 1 90); do
+  for p in $PIDS; do
+    kill -0 "$p" 2>/dev/null || {
+      echo "a replica died during startup:"
+      tail -5 "$RL/r1.log" "$RL/r2.log"
+      exit 1
+    }
+  done
+  if curl -s -o /dev/null http://localhost:19080/healthcheck \
+    && curl -s -o /dev/null http://localhost:29080/healthcheck; then
+    up=1
+    break
+  fi
+  sleep 1
+done
+[ "$up" = "1" ] || { echo "replicas never came up"; tail -5 "$RL/r1.log" "$RL/r2.log"; exit 1; }
+
+"${PY:-python}" -m ratelimit_tpu.cluster.proxy \
+  --replicas 127.0.0.1:19081,127.0.0.1:29081 \
+  --host 127.0.0.1 --port 19090 >"$RL/proxy.log" 2>&1 &
+PROXY_PID=$!
+PIDS="$PIDS $PROXY_PID"
+# Poll the proxy's gRPC port (no fixed sleep; bind failures die fast).
+up=0
+for i in $(seq 1 30); do
+  kill -0 "$PROXY_PID" 2>/dev/null || { echo "proxy died:"; tail -5 "$RL/proxy.log"; exit 1; }
+  if "${PY:-python}" -c "import socket,sys; s=socket.socket(); s.settimeout(0.5); sys.exit(0 if s.connect_ex(('127.0.0.1',19090))==0 else 1)"; then
+    up=1
+    break
+  fi
+  sleep 1
+done
+[ "$up" = "1" ] || { echo "proxy never bound 19090"; tail -5 "$RL/proxy.log"; exit 1; }
+
+# foo is 2/minute: through the proxy, call 3 must be OVER_LIMIT even
+# though two replicas each hold a full quota locally.
+out=""
+for i in 1 2 3; do
+  code=$("${PY:-python}" -m ratelimit_tpu.cli.client \
+    --dial_string 127.0.0.1:19090 --domain rl --descriptors foo=proxye2e \
+    2>/dev/null | grep -c "overall_code: OVER_LIMIT" || true)
+  out="$out $code"
+done
+[ "$out" = " 0 0 1" ] || { echo "expected joint 2/min enforcement, got:$out"; tail -5 "$RL/proxy.log"; exit 1; }
+
+# Single ownership: exactly one replica rejects the key directly.
+over=0
+for addr in 127.0.0.1:19081 127.0.0.1:29081; do
+  c=$("${PY:-python}" -m ratelimit_tpu.cli.client \
+    --dial_string "$addr" --domain rl --descriptors foo=proxye2e \
+    2>/dev/null | grep -c "overall_code: OVER_LIMIT" || true)
+  over=$((over + c))
+done
+[ "$over" = "1" ] || { echo "expected the counter on exactly one replica, got $over"; exit 1; }
+echo ok

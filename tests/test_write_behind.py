@@ -335,3 +335,22 @@ def test_restore_rebuilds_view(tmp_path, clock):
         wb2.flush()
     finally:
         wb2.close()
+
+
+def test_extreme_hits_never_reset_enforcement(wb, clock):
+    """The write-behind view counts in unbounded Python ints and the
+    device commit saturates (round-3 hardening): two u32-max-hit
+    requests must leave the key over-limit, not wrapped back to OK."""
+    mgr = Manager()
+    cfg = _cfg(mgr)
+    req = _req([[("k", "lap")]], hits=0xFFFFFFFF)
+    lim = _limits(cfg, req)
+    st = wb.do_limit(req, lim)[0]
+    assert st.code == Code.OVER_LIMIT
+    st = wb.do_limit(req, lim)[0]
+    assert st.code == Code.OVER_LIMIT
+    wb.flush()
+    st = wb.do_limit(_req([[("k", "lap")]]), lim)[0]
+    assert st.code == Code.OVER_LIMIT, "reconciled view must stay over"
+    # Device counter saturated, not wrapped.
+    assert int(wb.engine.export_counts().max()) == 0xFFFFFFFF
