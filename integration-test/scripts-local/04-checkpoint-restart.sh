@@ -73,3 +73,55 @@ start_server "$RL/gen2.log"; wait_up "$RL/gen2.log"
 code=$(curl -s -o /dev/null -w "%{http_code}" -XPOST --data "$body" http://localhost:18080/json)
 [ "$code" = "429" ] || fail "restarted server forgot the counter: got $code" "$RL/gen2.log"
 echo ok
+
+# Phase 1's gen2 is still running and the EXIT trap is about to be
+# replaced: stop it explicitly and wait for the ports to quiesce (the
+# gRPC listener uses SO_REUSEPORT, so a lingering old server would
+# otherwise share the port with phase 2's and absorb its traffic).
+kill -TERM "$SPID"
+wait "$SPID" 2>/dev/null || true
+SPID=""
+for i in $(seq 1 30); do
+  curl -s -o /dev/null http://localhost:18080/healthcheck || break
+  sleep 1
+done
+
+# --- phase 2: CRASH recovery (kill -9, restore from the periodic
+# checkpoint instead of the graceful-shutdown one) ---
+CKPT2=$(mktemp -d)
+RL2=$(mktemp -d)
+mkdir -p "$RL2/ratelimit/config"
+cp examples/ratelimit/config/example.yaml "$RL2/ratelimit/config/"
+cleanup2() {
+  if [ -n "$SPID" ]; then
+    kill -9 "$SPID" 2>/dev/null || true
+    wait "$SPID" 2>/dev/null || true
+  fi
+  rm -rf "$CKPT2" "$RL2" "$CKPT" "$RL"
+}
+trap cleanup2 EXIT
+
+start_server2() {
+  RUNTIME_ROOT="$RL2" RUNTIME_SUBDIRECTORY=ratelimit \
+    PORT=18080 GRPC_PORT=18081 DEBUG_PORT=16070 \
+    TPU_NUM_SLOTS=65536 TPU_BATCH_WINDOW_US=200 \
+    TPU_CHECKPOINT_DIR="$CKPT2" TPU_CHECKPOINT_INTERVAL_S=1 \
+    "${PY:-python}" -m ratelimit_tpu.runner >"$1" 2>&1 &
+  SPID=$!
+}
+
+body='{"domain":"rl","descriptors":[{"entries":[{"key":"hourly","value":"crash"}]}]}'
+start_server2 "$RL2/gen1.log"; wait_up "$RL2/gen1.log"
+for want in 200 200 429; do
+  code=$(curl -s -o /dev/null -w "%{http_code}" -XPOST --data "$body" http://localhost:18080/json)
+  [ "$code" = "$want" ] || fail "crash-gen1 expected $want, got $code" "$RL2/gen1.log"
+done
+sleep 3  # >= one periodic checkpoint interval after the hits landed
+kill -9 "$SPID"   # hard crash: no graceful final checkpoint
+wait "$SPID" 2>/dev/null || true
+[ -n "$(ls -A "$CKPT2")" ] || fail "no periodic checkpoint on disk" "$RL2/gen1.log"
+
+start_server2 "$RL2/gen2.log"; wait_up "$RL2/gen2.log"
+code=$(curl -s -o /dev/null -w "%{http_code}" -XPOST --data "$body" http://localhost:18080/json)
+[ "$code" = "429" ] || fail "crash-restarted server forgot the counter: got $code" "$RL2/gen2.log"
+echo ok-crash
