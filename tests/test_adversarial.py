@@ -477,3 +477,53 @@ def test_write_behind_many_thread_stress_exact(clock):
         assert view_total == want_total
     finally:
         cache.close()
+
+
+def test_empty_descriptor_and_unknown_domain_wire_shapes(clock):
+    """Reference edge semantics: a descriptor with zero entries and a
+    domain with no config both produce OK with no limit (GetLimit
+    returns nil -> no counter touched, ratelimit.go:104-146)."""
+    mgr = Manager()
+    cfg = _cfg(mgr)
+    cache = TpuRateLimitCache(
+        CounterEngine(num_slots=64, buckets=(8,)), time_source=clock
+    )
+    try:
+        # Zero-entry descriptor.
+        req = RateLimitRequest("adv", [Descriptor(())], 0)
+        lim = [cfg.get_limit(req.domain, d) for d in req.descriptors]
+        assert lim == [None]
+        st = cache.do_limit(req, lim)[0]
+        assert st.code == Code.OK
+        assert st.current_limit is None
+        # Unknown domain.
+        req = RateLimitRequest("nosuchdomain", [Descriptor.of(("a", "b"))], 0)
+        lim = [cfg.get_limit(req.domain, d) for d in req.descriptors]
+        assert lim == [None]
+        st = cache.do_limit(req, lim)[0]
+        assert st.code == Code.OK
+        # Neither touched the counter table.
+        cache.flush()
+        assert int(cache.engine.export_counts().sum()) == 0
+    finally:
+        cache.close()
+
+
+def test_config_check_cli_accepts_example_and_rejects_bad(tmp_path, capsys):
+    """The offline validator binary semantics (reference
+    config_check_cmd/main.go:104-143): exit 0 on the shipped example
+    config, exit 1 with the loader's error on a malformed dir."""
+    from ratelimit_tpu.cli import config_check
+
+    assert config_check.main(["--config_dir", "examples/ratelimit/config"]) == 0
+    out = capsys.readouterr().out
+    assert "rl.foo" in out  # dump() of the loaded config printed
+
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "broken.yaml").write_text(
+        "domain: d\ndescriptors:\n  - key: k\n    rate_limit:\n"
+        "      unit: lightyears\n      requests_per_unit: 1\n"
+    )
+    assert config_check.main(["--config_dir", str(bad)]) == 1
+    assert "error loading config" in capsys.readouterr().err
