@@ -57,6 +57,105 @@ def build_router(replica_addrs: List[str]) -> ReplicaRouter:
     )
 
 
+class RouterHolder:
+    """Atomically swappable router — the live-membership seam.
+
+    The server handler calls ``should_rate_limit`` through the holder;
+    a membership change builds a COMPLETE new router and swaps it in
+    with one reference assignment (readers see either the old or the
+    new router, never a mix — the same single-slot-swap discipline as
+    the config hot-reload).  Rendezvous hashing makes the data-plane
+    consequence minimal: only keys whose owner changed (~1/n) move,
+    and those counters restart their window (the documented amnesia
+    envelope, docs/MULTI_REPLICA.md).  The old router's thread pool is
+    retired after a grace period; its gRPC channels stay open for the
+    process lifetime (bounded by membership churn).
+    """
+
+    def __init__(self, router: ReplicaRouter):
+        self._router = router
+
+    @property
+    def replica_ids(self) -> List[str]:
+        return self._router.replica_ids
+
+    def should_rate_limit(self, request):
+        return self._router.should_rate_limit(request)
+
+    def swap(self, new_router: ReplicaRouter, grace_s: float = 30.0) -> None:
+        old, self._router = self._router, new_router
+        t = threading.Timer(grace_s, old.close)
+        t.daemon = True
+        t.start()
+
+    def close(self) -> None:
+        self._router.close()
+
+
+def read_replicas_file(path: str) -> List[str]:
+    """One address per line (or comma/space separated); '#' comments."""
+    addrs: List[str] = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0]
+            for tok in line.replace(",", " ").split():
+                addrs.append(tok)
+    return addrs
+
+
+def watch_replicas_file(
+    holder: RouterHolder, path: str, poll_s: float = 2.0
+):
+    """Poll `path` and swap the holder's router when the membership
+    SET changes (the goruntime-watcher pattern the reference uses for
+    limit configs, applied to cluster membership).  Any bad state —
+    unreadable file, empty list, duplicate addresses, a write racing
+    the read — keeps the old membership and RETRIES on the next poll
+    (the keep-old-on-error rule of config reload).  Prefer atomic
+    (write-temp + rename) updates to the file; a mid-write read is
+    additionally rejected by the stable-mtime check.
+
+    Returns (thread, stop_event); set the event to stop the watcher.
+    """
+    stop = threading.Event()
+
+    def loop() -> None:
+        last_mtime = None
+        import os
+
+        while not stop.is_set():
+            try:
+                mtime = os.path.getmtime(path)
+                if mtime != last_mtime:
+                    addrs = read_replicas_file(path)
+                    # Reject reads that raced a non-atomic writer: the
+                    # mtime must be unchanged across the read.
+                    if os.path.getmtime(path) != mtime:
+                        stop.wait(poll_s)
+                        continue  # retry next poll
+                    if addrs and set(addrs) != set(holder.replica_ids):
+                        holder.swap(build_router(addrs))
+                        logger.warning(
+                            "cluster membership now %d replicas: %s",
+                            len(addrs),
+                            ",".join(addrs),
+                        )
+                    # Only mark consumed after a SUCCESSFUL read+apply
+                    # (a transient error above must retry, not skip).
+                    last_mtime = mtime
+            except Exception as e:  # keep-old-on-error, keep polling
+                logger.error(
+                    "replicas file update failed (%s); keeping "
+                    "current membership",
+                    e,
+                )
+            stop.wait(poll_s)
+
+    t = threading.Thread(target=loop, name="replica-watcher", daemon=True)
+    t.start()
+    return t, stop
+
+
 def make_server(router: ReplicaRouter, host: str, port: int):
     """Build the proxy's gRPC server; returns (server, bound_port) —
     port 0 selects an ephemeral port (tests)."""
@@ -90,19 +189,30 @@ def make_server(router: ReplicaRouter, host: str, port: int):
 
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument(
+    g = p.add_mutually_exclusive_group(required=True)
+    g.add_argument(
         "--replicas",
-        required=True,
         help="comma-separated replica gRPC addresses (host:port); the "
         "address strings are the stable hash identities",
     )
+    g.add_argument(
+        "--replicas-file",
+        help="file of replica addresses, POLLED for live membership "
+        "changes (rendezvous: only moved keys reset their window)",
+    )
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8082)
+    p.add_argument("--poll-seconds", type=float, default=2.0)
     args = p.parse_args(argv)
 
-    addrs = [a.strip() for a in args.replicas.split(",") if a.strip()]
-    router = build_router(addrs)
-    server, bound = make_server(router, args.host, args.port)
+    if args.replicas_file:
+        addrs = read_replicas_file(args.replicas_file)
+    else:
+        addrs = [a.strip() for a in args.replicas.split(",") if a.strip()]
+    holder = RouterHolder(build_router(addrs))
+    if args.replicas_file:
+        watch_replicas_file(holder, args.replicas_file, args.poll_seconds)
+    server, bound = make_server(holder, args.host, args.port)
     server.start()
     logger.warning(
         "cluster proxy serving :%d over %d replicas", bound, len(addrs)
@@ -112,7 +222,7 @@ def main(argv=None) -> None:
         signal.signal(sig, lambda *_: stop.set())
     stop.wait()
     server.stop(grace=5).wait()
-    router.close()
+    holder.close()
 
 
 if __name__ == "__main__":

@@ -515,7 +515,15 @@ def test_config_check_cli_accepts_example_and_rejects_bad(tmp_path, capsys):
     config, exit 1 with the loader's error on a malformed dir."""
     from ratelimit_tpu.cli import config_check
 
-    assert config_check.main(["--config_dir", "examples/ratelimit/config"]) == 0
+    import os
+
+    example_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples",
+        "ratelimit",
+        "config",
+    )
+    assert config_check.main(["--config_dir", example_dir]) == 0
     out = capsys.readouterr().out
     assert "rl.foo" in out  # dump() of the loaded config printed
 
