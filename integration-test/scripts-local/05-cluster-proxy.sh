@@ -102,3 +102,56 @@ for addr in 127.0.0.1:19081 127.0.0.1:29081; do
 done
 [ "$over" = "1" ] || { echo "expected the counter on exactly one replica, got $over"; exit 1; }
 echo ok
+
+# --- phase 2: LIVE membership growth (--replicas-file) ---
+# A third replica joins by appending to the watched file; the proxy
+# swaps membership without restarting, and traffic keeps flowing.
+RUNTIME_ROOT="$RL/r1" RUNTIME_SUBDIRECTORY=ratelimit \
+  PORT=39080 GRPC_PORT=39081 DEBUG_PORT=39070 TPU_NUM_SLOTS=65536 \
+  "${PY:-python}" -m ratelimit_tpu.runner >"$RL/r3.log" 2>&1 &
+PIDS="$PIDS $!"
+for i in $(seq 1 90); do
+  curl -s -o /dev/null http://localhost:39080/healthcheck && break
+  sleep 1
+done
+
+printf '127.0.0.1:19081\n127.0.0.1:29081\n' > "$RL/replicas.txt"
+"${PY:-python}" -m ratelimit_tpu.cluster.proxy \
+  --replicas-file "$RL/replicas.txt" --poll-seconds 0.5 \
+  --host 127.0.0.1 --port 29090 >"$RL/proxy2.log" 2>&1 &
+PIDS="$PIDS $!"
+for i in $(seq 1 30); do
+  "${PY:-python}" -c "import socket,sys; s=socket.socket(); s.settimeout(0.5); sys.exit(0 if s.connect_ex(('127.0.0.1',29090))==0 else 1)" && break
+  sleep 1
+done
+
+# Traffic flows on the initial 2-replica membership.
+c=$("${PY:-python}" -m ratelimit_tpu.cli.client \
+  --dial_string 127.0.0.1:29090 --domain rl --descriptors foo=member1 \
+  2>/dev/null | grep -c "overall_code: OK" || true)
+[ "$c" = "1" ] || { echo "proxy not serving before growth"; tail -5 "$RL/proxy2.log"; exit 1; }
+
+# Grow membership atomically (write-temp + rename) and wait for the
+# watcher to log the swap.
+printf '127.0.0.1:19081\n127.0.0.1:29081\n127.0.0.1:39081\n' > "$RL/replicas.txt.tmp"
+mv "$RL/replicas.txt.tmp" "$RL/replicas.txt"
+grew=0
+for i in $(seq 1 20); do
+  if grep -q "cluster membership now 3 replicas" "$RL/proxy2.log"; then
+    grew=1
+    break
+  fi
+  sleep 1
+done
+[ "$grew" = "1" ] || { echo "membership growth never observed"; tail -5 "$RL/proxy2.log"; exit 1; }
+
+# Traffic still flows after the swap, and across many keys at least
+# one routes to the NEW replica (its counter appears on r3).
+for i in $(seq 1 30); do
+  "${PY:-python}" -m ratelimit_tpu.cli.client \
+    --dial_string 127.0.0.1:29090 --domain rl --descriptors "foo=grown$i" \
+    >/dev/null 2>&1 || { echo "proxy broke after membership swap"; exit 1; }
+done
+r3_keys=$(curl -s http://localhost:39070/stats | grep "ratelimit.tpu.bank0.live_keys" | grep -o "[0-9]*$")
+[ "$r3_keys" -ge 1 ] 2>/dev/null || { echo "new replica never received a key (live_keys=$r3_keys)"; exit 1; }
+echo ok-membership
