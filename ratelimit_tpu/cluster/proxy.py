@@ -158,7 +158,11 @@ def watch_replicas_file(
 
 def make_server(router: ReplicaRouter, host: str, port: int):
     """Build the proxy's gRPC server; returns (server, bound_port) —
-    port 0 selects an ephemeral port (tests)."""
+    port 0 selects an ephemeral port (tests).  Serves the standard
+    grpc.health.v1 service alongside the rate-limit API (load
+    balancers probe the proxy the same way they probe replicas;
+    always SERVING — the proxy holds no state that can fail, replica
+    failures surface per-request)."""
     def should_rate_limit(request_pb, context):
         try:
             return router.should_rate_limit(request_pb)
@@ -177,8 +181,27 @@ def make_server(router: ReplicaRouter, host: str, port: int):
             )
         },
     )
+    from grpchealth.v1 import health_pb2  # noqa: PLC0415
+
+    def health_check(request_pb, context):
+        return health_pb2.HealthCheckResponse(
+            status=health_pb2.HealthCheckResponse.SERVING
+        )
+
+    health_handler = grpc.method_handlers_generic_handler(
+        "grpc.health.v1.Health",
+        {
+            "Check": grpc.unary_unary_rpc_method_handler(
+                health_check,
+                request_deserializer=health_pb2.HealthCheckRequest.FromString,
+                response_serializer=(
+                    health_pb2.HealthCheckResponse.SerializeToString
+                ),
+            )
+        },
+    )
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
-    server.add_generic_rpc_handlers((handler,))
+    server.add_generic_rpc_handlers((handler, health_handler))
     bound = server.add_insecure_port(f"{host}:{port}")
     if bound == 0:
         # grpcio returns 0 instead of raising when the bind fails

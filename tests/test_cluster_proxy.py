@@ -183,3 +183,19 @@ def test_live_membership_change_via_replicas_file(tmp_path):
     finally:
         stop.set()
         holder.close()
+
+
+def test_proxy_serves_grpc_health(stack):
+    """Load balancers probe the proxy like any replica: the standard
+    grpc.health.v1 Check answers SERVING."""
+    from grpchealth.v1 import health_pb2
+
+    runners, router, server, proxy_addr = stack
+    with grpc.insecure_channel(proxy_addr) as channel:
+        check = channel.unary_unary(
+            "/grpc.health.v1.Health/Check",
+            request_serializer=health_pb2.HealthCheckRequest.SerializeToString,
+            response_deserializer=health_pb2.HealthCheckResponse.FromString,
+        )
+        resp = check(health_pb2.HealthCheckRequest(), timeout=10)
+    assert resp.status == health_pb2.HealthCheckResponse.SERVING
