@@ -72,7 +72,9 @@ def main() -> None:
         t0 = time.perf_counter()
         for i, b in enumerate(batches):
             token = engine.step_submit(b)
-            widths.append(token[1][0][0].shape[1])  # routed cap
+            # token = (hits, limits, shadow, chunks); chunks[0][0] is
+            # the routed (num_banks, cap) device afters handle.
+            widths.append(token[3][0][0].shape[1])  # routed cap
             d = engine.step_complete(token)
             np.testing.assert_array_equal(
                 d.codes, ref_decisions[i].codes, err_msg=f"mesh {nd}"
