@@ -50,17 +50,26 @@ def main(argv=None) -> None:
     p.add_argument("--seconds", type=int, default=180)
     p.add_argument("--threads", type=int, default=4)
     p.add_argument("--growth-bound-mb", type=float, default=30.0)
+    p.add_argument(
+        "--backend", choices=("sync", "write-behind"), default="sync"
+    )
     args = p.parse_args(argv)
 
     from ratelimit_tpu.api import Descriptor, RateLimitRequest
     from ratelimit_tpu.backends.engine import CounterEngine
     from ratelimit_tpu.backends.tpu_cache import TpuRateLimitCache
+    from ratelimit_tpu.backends.write_behind import WriteBehindRateLimitCache
     from ratelimit_tpu.config.loader import ConfigFile, load_config
     from ratelimit_tpu.stats.manager import Manager
 
     mgr = Manager()
     cfg = load_config([ConfigFile("c", YAML)], mgr)
-    cache = TpuRateLimitCache(
+    cache_cls = (
+        WriteBehindRateLimitCache
+        if args.backend == "write-behind"
+        else TpuRateLimitCache
+    )
+    cache = cache_cls(
         CounterEngine(num_slots=1 << 16, buckets=(8, 32, 128)),
         batch_window_us=200,
     )
@@ -111,7 +120,8 @@ def main(argv=None) -> None:
     late = float(np.mean([s["rss_mb"] for s in samples[-3:]]))
     out = {
         "note": (
-            f"{args.seconds}s closed-loop soak, {args.threads} threads, "
+            f"{args.seconds}s closed-loop soak ({args.backend} backend), "
+            f"{args.threads} threads, "
             "SECOND-unit windows (slot-table churn every second), "
             "1-core CPU platform, clean env; early ramp = slot table/"
             "memo/arenas filling to capacity, then plateau"
@@ -123,7 +133,10 @@ def main(argv=None) -> None:
         "rss_late_mb": round(late, 1),
         "growth_mb": round(late - early, 1),
     }
-    path = os.path.join(os.path.dirname(__file__), "results", "soak_rss.json")
+    suffix = "" if args.backend == "sync" else "_wb"
+    path = os.path.join(
+        os.path.dirname(__file__), "results", f"soak_rss{suffix}.json"
+    )
     with open(path, "w") as f:
         json.dump(out, f, indent=2)
     print(
