@@ -19,9 +19,16 @@ Layering (mirrors reference src/ layering, SURVEY.md section 1):
 - ``parallel``  -- mesh-sharded multi-chip counter engine.
 - ``service``   -- ShouldRateLimit service logic (aggregate codes,
                    headers, shadow modes, hot reload).
-- ``server``    -- gRPC + JSON/HTTP + health/debug serving surfaces.
+- ``server``    -- gRPC + JSON/HTTP + health/debug serving surfaces
+                   (incl. live introspection: threadz/profile/xla_trace).
 - ``stats``     -- counter tree + statsd export.
 - ``runtime``   -- config directory watcher.
+- ``cluster``   -- multi-replica tier: rendezvous key routing + the
+                   stateless front proxy with live membership.
+
+Backends (``BACKEND_TYPE``): ``tpu`` (sync), ``tpu-sharded`` (mesh),
+``tpu-write-behind`` / ``tpu-sharded-write-behind`` (memcached-mode
+async commits), ``memory`` (host oracle).
 """
 
 __version__ = "0.1.0"
