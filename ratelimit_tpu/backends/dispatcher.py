@@ -310,6 +310,17 @@ class BatchDispatcher:
         self.batch_limit = int(batch_limit)
         self.unhealthy_after = int(unhealthy_after)
         self.on_state = on_state
+        # Proactive slot-table gc: without it, expired keys linger in
+        # the table until the free list empties (Redis expires keys
+        # lazily too, but also actively samples; fixed 10-key-space
+        # traffic would otherwise hold the map/heap at table-capacity
+        # high-water forever and skew the live_keys gauge).  Runs on
+        # the collector (the table's owner), clocked by the ITEMS' own
+        # time source (tests pin time; wall clock would mass-expire
+        # their keys).
+        self.gc_interval_s = 5.0
+        self._last_item_now = None
+        self._next_gc_monotonic = time.monotonic() + self.gc_interval_s
         self._state_lock = threading.Lock()
         self._consecutive_failures = 0
         self._reported_unhealthy = False
@@ -552,7 +563,21 @@ class BatchDispatcher:
             while True:
                 batch, tokens, stopping = self._collect()
                 if batch:
+                    # The LATEST batch's clock, not an all-time max: a
+                    # single item with an anomalous future `now` (clock
+                    # step) must not latch and mass-expire live keys on
+                    # every later gc tick — a stale-low now merely gc's
+                    # less until the next batch.
+                    self._last_item_now = max(it.now for it in batch)
                     self._launch(batch)
+                if (
+                    self._last_item_now is not None
+                    and time.monotonic() >= self._next_gc_monotonic
+                ):
+                    self._next_gc_monotonic = (
+                        time.monotonic() + self.gc_interval_s
+                    )
+                    self.engine.gc(self._last_item_now)
                 for t in tokens:
                     if isinstance(t, _CallToken):
                         # Calls (checkpoints) run HERE — the collector

@@ -6,6 +6,7 @@ memcache writes (reference src/memcached/cache_impl.go:54,176-178).
 """
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -165,3 +166,41 @@ def test_engine_error_propagates_as_cache_error(clock):
             )
     finally:
         cache.close()
+
+
+def test_collector_runs_periodic_gc(clock):
+    """Expired keys are reclaimed proactively (Redis active-expiry
+    analog): without periodic gc they would linger until the free
+    list emptied, holding the table at high-water and skewing the
+    live_keys gauge.  The gc clock is the ITEMS' time source, never
+    the wall clock (tests pin time)."""
+    engine = CounterEngine(num_slots=64, buckets=(8,))
+    d = BatchDispatcher(engine, batch_window_us=100, batch_limit=4096)
+    try:
+        it = WorkItem(
+            now=0,
+            lanes=[Lane(key="old_0", expiry=1, limit=10, shadow=False, hits=1)],
+            apply=lambda dec: None,
+        )
+        d.submit(it)
+        it.wait(30)
+        assert len(engine.slot_table) == 1
+
+        # Make the next collect cycle due for gc, then drive traffic
+        # whose `now` is past the first key's expiry.
+        d.gc_interval_s = 0.0
+        d._next_gc_monotonic = 0.0
+        it2 = WorkItem(
+            now=10,
+            lanes=[Lane(key="new_0", expiry=60, limit=10, shadow=False, hits=1)],
+            apply=lambda dec: None,
+        )
+        d.submit(it2)
+        it2.wait(30)
+        d.flush()
+        deadline = time.monotonic() + 5
+        while len(engine.slot_table) > 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(engine.slot_table) == 1  # old_0 reclaimed, new_0 lives
+    finally:
+        d.stop()
