@@ -388,3 +388,64 @@ def test_grpc_extreme_hits_addend(runner):
     # Follow-up normal request on the same key: still over, sane.
     resp = _grpc_call(runner, _request("basic", [("key1", "maxhits")]))
     assert resp.overall_code == rls_pb2.RateLimitResponse.OVER_LIMIT
+
+
+def test_grpc_health_watch_streams_transitions(runner):
+    """grpc.health.v1 Watch: the stream yields the current status
+    immediately and pushes transitions as they happen (the reference
+    registers the standard health service whose Watch does exactly
+    this; our impl is condition-variable driven, server/health.py)."""
+    import queue as _queue
+    import threading as _threading
+
+    with grpc.insecure_channel(
+        f"127.0.0.1:{runner.grpc_server.bound_port}"
+    ) as channel:
+        watch = channel.unary_stream(
+            "/grpc.health.v1.Health/Watch",
+            request_serializer=health_pb2.HealthCheckRequest.SerializeToString,
+            response_deserializer=health_pb2.HealthCheckResponse.FromString,
+        )
+        stream = watch(health_pb2.HealthCheckRequest(), timeout=30)
+        updates: "_queue.Queue" = _queue.Queue()
+
+        def reader():
+            try:
+                for resp in stream:
+                    updates.put(resp.status)
+            except Exception:
+                pass
+
+        t = _threading.Thread(target=reader, daemon=True)
+        t.start()
+        try:
+            first = updates.get(timeout=10)
+            assert first == health_pb2.HealthCheckResponse.SERVING
+            runner.health.fail()
+            assert (
+                updates.get(timeout=10)
+                == health_pb2.HealthCheckResponse.NOT_SERVING
+            )
+            runner.health.ok()
+            assert (
+                updates.get(timeout=10)
+                == health_pb2.HealthCheckResponse.SERVING
+            )
+        finally:
+            runner.health.ok()
+            stream.cancel()
+            t.join(timeout=5)
+
+
+def test_stats_json_endpoint(runner):
+    """/stats.json mirrors /stats as machine-readable JSON (counters,
+    gauges, timer summaries)."""
+    status, out = _http(
+        runner, "/stats.json", port=runner.debug_server.bound_port
+    )
+    assert status == 200
+    parsed = json.loads(out)
+    assert "stats" in parsed and "timers" in parsed
+    assert any(
+        k.startswith("ratelimit.service.") for k in parsed["stats"]
+    )
