@@ -43,8 +43,13 @@ def grpc_transport(channel: grpc.Channel):
         response_deserializer=rls_pb2.RateLimitResponse.FromString,
     )
 
-    def call(request: rls_pb2.RateLimitRequest) -> rls_pb2.RateLimitResponse:
-        return method(request, timeout=30)
+    def call(
+        request: rls_pb2.RateLimitRequest, timeout_s=None
+    ) -> rls_pb2.RateLimitResponse:
+        # Cap by the client's remaining deadline when provided; 30s
+        # liveness backstop otherwise.
+        t = 30.0 if timeout_s is None else max(0.001, min(30.0, timeout_s))
+        return method(request, timeout=t)
 
     return call
 
@@ -79,8 +84,8 @@ class RouterHolder:
     def replica_ids(self) -> List[str]:
         return self._router.replica_ids
 
-    def should_rate_limit(self, request):
-        return self._router.should_rate_limit(request)
+    def should_rate_limit(self, request, timeout_s=None):
+        return self._router.should_rate_limit(request, timeout_s=timeout_s)
 
     def swap(self, new_router: ReplicaRouter, grace_s: float = 30.0) -> None:
         old, self._router = self._router, new_router
@@ -165,7 +170,11 @@ def make_server(router: ReplicaRouter, host: str, port: int):
     failures surface per-request)."""
     def should_rate_limit(request_pb, context):
         try:
-            return router.should_rate_limit(request_pb)
+            # Propagate the caller's remaining deadline to replica
+            # sub-calls (time_remaining() is None without a deadline).
+            return router.should_rate_limit(
+                request_pb, timeout_s=context.time_remaining()
+            )
         except grpc.RpcError as e:
             # Propagate the replica's status (e.g. INVALID_ARGUMENT on
             # empty domain) instead of wrapping it in UNKNOWN.

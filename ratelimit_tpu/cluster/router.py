@@ -36,7 +36,7 @@ from __future__ import annotations
 
 import hashlib
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, Dict, List, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 from ..server import pb  # noqa: F401  (sys.path for generated protos)
 
@@ -76,7 +76,11 @@ def owner_of(key: str, replica_ids: Sequence[str]) -> int:
     return best_i
 
 
-Transport = Callable[[rls_pb2.RateLimitRequest], rls_pb2.RateLimitResponse]
+# Transport: call(request, timeout_s=None) -> response.  `timeout_s`
+# carries the CLIENT's remaining deadline down to replica sub-calls
+# so the proxy never keeps waiting on a replica after its caller has
+# already given up.
+Transport = Callable[..., rls_pb2.RateLimitResponse]
 
 
 class ReplicaRouter:
@@ -112,14 +116,16 @@ class ReplicaRouter:
         return owner_of(routing_key(domain, descriptor), self.replica_ids)
 
     def should_rate_limit(
-        self, request: rls_pb2.RateLimitRequest
+        self,
+        request: rls_pb2.RateLimitRequest,
+        timeout_s: Optional[float] = None,
     ) -> rls_pb2.RateLimitResponse:
         n = len(request.descriptors)
         if n == 0:
             # Single replica answers the empty/error case so the wire
             # behavior (INVALID_ARGUMENT on empty domain etc.) is the
             # service's own, not a router invention.
-            return self.transports[0](request)
+            return self.transports[0](request, timeout_s=timeout_s)
 
         by_owner: Dict[int, List[int]] = {}
         for i, d in enumerate(request.descriptors):
@@ -127,7 +133,7 @@ class ReplicaRouter:
 
         if len(by_owner) == 1:
             owner = next(iter(by_owner))
-            return self.transports[owner](request)
+            return self.transports[owner](request, timeout_s=timeout_s)
 
         def sub_call(owner: int, rows: List[int]):
             sub = rls_pb2.RateLimitRequest(
@@ -135,7 +141,7 @@ class ReplicaRouter:
             )
             for i in rows:
                 sub.descriptors.add().CopyFrom(request.descriptors[i])
-            return rows, self.transports[owner](sub)
+            return rows, self.transports[owner](sub, timeout_s=timeout_s)
 
         # One owner's call runs inline on the request thread (which
         # would otherwise just block in result()); only the rest go to

@@ -129,7 +129,7 @@ def test_live_membership_change_via_replicas_file(tmp_path):
     from ratelimit_tpu.cluster.router import ReplicaRouter
 
     def fake(addr):
-        def call(req):
+        def call(req, timeout_s=None):
             resp = rls_pb2.RateLimitResponse(
                 overall_code=rls_pb2.RateLimitResponse.OK
             )

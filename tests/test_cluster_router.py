@@ -82,7 +82,7 @@ def test_routing_key_matches_cache_key_granularity():
 
 
 def _fake_service(code, remaining=3):
-    def call(req):
+    def call(req, timeout_s=None):
         resp = rls_pb2.RateLimitResponse(overall_code=code)
         for _ in req.descriptors:
             s = resp.statuses.add()
