@@ -27,7 +27,7 @@ from ..server import pb  # noqa: F401
 
 from envoy.service.ratelimit.v3 import rls_pb2  # noqa: E402
 
-from .router import ReplicaRouter  # noqa: E402
+from .router import DeadlineExceededError, ReplicaRouter  # noqa: E402
 
 logger = logging.getLogger("ratelimit.cluster.proxy")
 
@@ -46,9 +46,9 @@ def grpc_transport(channel: grpc.Channel):
     def call(
         request: rls_pb2.RateLimitRequest, timeout_s=None
     ) -> rls_pb2.RateLimitResponse:
-        # Cap by the client's remaining deadline when provided; 30s
+        # Bounded by the caller's remaining budget when provided; 30s
         # liveness backstop otherwise.
-        t = 30.0 if timeout_s is None else max(0.001, min(30.0, timeout_s))
+        t = 30.0 if timeout_s is None else min(30.0, timeout_s)
         return method(request, timeout=t)
 
     return call
@@ -169,12 +169,20 @@ def make_server(router: ReplicaRouter, host: str, port: int):
     always SERVING — the proxy holds no state that can fail, replica
     failures surface per-request)."""
     def should_rate_limit(request_pb, context):
+        remaining = context.time_remaining()
+        if remaining is not None and remaining <= 0:
+            # Already expired: don't issue doomed replica RPCs.
+            context.abort(
+                grpc.StatusCode.DEADLINE_EXCEEDED, "client deadline expired"
+            )
         try:
             # Propagate the caller's remaining deadline to replica
             # sub-calls (time_remaining() is None without a deadline).
             return router.should_rate_limit(
-                request_pb, timeout_s=context.time_remaining()
+                request_pb, timeout_s=remaining
             )
+        except DeadlineExceededError as e:
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
         except grpc.RpcError as e:
             # Propagate the replica's status (e.g. INVALID_ARGUMENT on
             # empty domain) instead of wrapping it in UNKNOWN.

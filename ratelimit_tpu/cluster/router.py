@@ -24,19 +24,24 @@ window excluded — pins every window of a given counter to one replica,
 which keeps counting exact without any cross-replica traffic.
 
 The router speaks the wire protos and is transport-agnostic: each
-replica is a callable ``RateLimitRequest -> RateLimitResponse`` (a
-gRPC stub bound by cluster/proxy.py, or an in-process service in
-tests).  Descriptors are split by owner, sub-requests fan out
-concurrently, and the sub-responses merge back preserving descriptor
-order, the OR overall-code rule, and the min-remaining header
-semantics of the single service (service/ratelimit.go:165-209).
+replica is a callable ``(RateLimitRequest, timeout_s=None) ->
+RateLimitResponse`` (the Transport protocol below; a gRPC stub bound
+by cluster/proxy.py, or an in-process fake in tests).  Descriptors
+are split by owner, sub-requests fan out concurrently, and the
+sub-responses merge back preserving descriptor order, the OR
+overall-code rule, and the min-remaining header semantics of the
+single service (service/ratelimit.go:165-209).  A caller-supplied
+deadline is carried as an ABSOLUTE budget: each sub-call receives
+only the time remaining when it actually starts, so pool queueing
+can never stretch the total past the caller's deadline.
 """
 
 from __future__ import annotations
 
 import hashlib
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, Dict, List, Optional, Sequence
+import time
+from typing import Dict, List, Optional, Protocol, Sequence
 
 from ..server import pb  # noqa: F401  (sys.path for generated protos)
 
@@ -76,11 +81,21 @@ def owner_of(key: str, replica_ids: Sequence[str]) -> int:
     return best_i
 
 
-# Transport: call(request, timeout_s=None) -> response.  `timeout_s`
-# carries the CLIENT's remaining deadline down to replica sub-calls
-# so the proxy never keeps waiting on a replica after its caller has
-# already given up.
-Transport = Callable[..., rls_pb2.RateLimitResponse]
+class DeadlineExceededError(RuntimeError):
+    """The caller's deadline expired before (or while) fanning out —
+    the proxy maps this to gRPC DEADLINE_EXCEEDED."""
+
+
+class Transport(Protocol):
+    """One replica endpoint.  `timeout_s` is the time REMAINING in
+    the caller's budget when this call starts (None = no deadline);
+    implementations should bound their wait by it."""
+
+    def __call__(
+        self,
+        request: rls_pb2.RateLimitRequest,
+        timeout_s: Optional[float] = None,
+    ) -> rls_pb2.RateLimitResponse: ...
 
 
 class ReplicaRouter:
@@ -120,12 +135,26 @@ class ReplicaRouter:
         request: rls_pb2.RateLimitRequest,
         timeout_s: Optional[float] = None,
     ) -> rls_pb2.RateLimitResponse:
+        # Absolute deadline: every sub-call gets the budget REMAINING
+        # when it starts (pool queueing eats from the same budget).
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+
+        def remaining() -> Optional[float]:
+            if deadline is None:
+                return None
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise DeadlineExceededError(
+                    "caller deadline expired before the replica call"
+                )
+            return left
+
         n = len(request.descriptors)
         if n == 0:
             # Single replica answers the empty/error case so the wire
             # behavior (INVALID_ARGUMENT on empty domain etc.) is the
             # service's own, not a router invention.
-            return self.transports[0](request, timeout_s=timeout_s)
+            return self.transports[0](request, timeout_s=remaining())
 
         by_owner: Dict[int, List[int]] = {}
         for i, d in enumerate(request.descriptors):
@@ -133,7 +162,7 @@ class ReplicaRouter:
 
         if len(by_owner) == 1:
             owner = next(iter(by_owner))
-            return self.transports[owner](request, timeout_s=timeout_s)
+            return self.transports[owner](request, timeout_s=remaining())
 
         def sub_call(owner: int, rows: List[int]):
             sub = rls_pb2.RateLimitRequest(
@@ -141,7 +170,7 @@ class ReplicaRouter:
             )
             for i in rows:
                 sub.descriptors.add().CopyFrom(request.descriptors[i])
-            return rows, self.transports[owner](sub, timeout_s=timeout_s)
+            return rows, self.transports[owner](sub, timeout_s=remaining())
 
         # One owner's call runs inline on the request thread (which
         # would otherwise just block in result()); only the rest go to

@@ -275,3 +275,38 @@ def test_concurrent_load_through_router_counts_exactly(replicas, router):
             ok_counts[k],
             over_counts[k],
         )
+
+
+def test_expired_deadline_fails_fast_without_replica_calls():
+    """An exhausted caller budget raises DeadlineExceededError before
+    any replica transport runs (the proxy maps it to
+    DEADLINE_EXCEEDED) — no doomed sub-calls under overload."""
+    from ratelimit_tpu.cluster.router import DeadlineExceededError
+
+    calls = []
+
+    def transport(req, timeout_s=None):
+        calls.append(timeout_s)
+        resp = rls_pb2.RateLimitResponse(
+            overall_code=rls_pb2.RateLimitResponse.OK
+        )
+        for _ in req.descriptors:
+            resp.statuses.add().code = rls_pb2.RateLimitResponse.OK
+        return resp
+
+    router = ReplicaRouter(["a"], [transport])
+    try:
+        req = _request("basic", [[("key1", "dl")]])
+        # Healthy budget: call goes through with a shrunken remaining.
+        resp = router.should_rate_limit(req, timeout_s=5.0)
+        assert resp.overall_code == rls_pb2.RateLimitResponse.OK
+        assert calls and 0 < calls[0] <= 5.0
+        # Expired budget: no transport call at all.
+        calls.clear()
+        import pytest as _pytest
+
+        with _pytest.raises(DeadlineExceededError):
+            router.should_rate_limit(req, timeout_s=0.0)
+        assert calls == []
+    finally:
+        router.close()
