@@ -126,7 +126,13 @@ def main() -> None:
         for s in range(STEPS_PER_CALL):
             sl, hi, li, fr = h_slots[s], h_hits[s], h_limits[s], h_fresh[s]
             before = np.where(fr, np.uint32(0), table[sl])
-            after = before + hi
+            # Saturating add, mirroring the device counter domain
+            # (update_unique clamps at u32 max instead of wrapping);
+            # bench values never reach it, but the replay formula must
+            # match the kernel's semantics exactly.
+            after = np.minimum(
+                before.astype(np.uint64) + hi, np.uint64(0xFFFFFFFF)
+            ).astype(np.uint32)
             table[sl] = after
             sat = np.minimum(after, li + hi).astype(np.uint16)
             acc = np.uint32(acc + np.uint32(sat.astype(np.uint32).sum()))
