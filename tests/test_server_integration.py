@@ -449,3 +449,68 @@ def test_stats_json_endpoint(runner):
     assert any(
         k.startswith("ratelimit.service.") for k in parsed["stats"]
     )
+
+
+def test_per_second_bank_wired_through_runner(tmp_path_factory):
+    """TPU_PERSECOND=true gives SECOND-unit limits their own counter
+    bank + dispatcher (the dual-Redis analog, fixed_cache_impl.go:
+    77-87), wired by the Runner and visible in the bank gauges."""
+    root = tmp_path_factory.mktemp("persec-runtime")
+    config_dir = root / "ratelimit" / "config"
+    config_dir.mkdir(parents=True)
+    (config_dir / "ps.yaml").write_text(
+        "domain: ps\n"
+        "descriptors:\n"
+        "  - key: persec\n"
+        "    rate_limit:\n"
+        "      unit: second\n"
+        "      requests_per_unit: 2\n"
+        "  - key: perminute\n"
+        "    rate_limit:\n"
+        "      unit: minute\n"
+        "      requests_per_unit: 100\n"
+    )
+    r = Runner(
+        Settings(
+            host="127.0.0.1",
+            port=0,
+            grpc_host="127.0.0.1",
+            grpc_port=0,
+            debug_host="127.0.0.1",
+            debug_port=0,
+            use_statsd=False,
+            backend_type="tpu",
+            tpu_num_slots=1 << 10,
+            tpu_per_second=True,
+            tpu_per_second_num_slots=1 << 10,
+            tpu_batch_window_us=200,
+            tpu_batch_buckets=[8, 32],
+            runtime_path=str(root),
+            runtime_subdirectory="ratelimit",
+            local_cache_size_in_bytes=0,
+            expiration_jitter_max_seconds=0,
+        )
+    )
+    r.start()
+    try:
+        assert r.cache.per_second_engine is not None
+        OK = rls_pb2.RateLimitResponse.OK
+        OVER = rls_pb2.RateLimitResponse.OVER_LIMIT
+        codes = [
+            _grpc_call(r, _request("ps", [("persec", "x")])).overall_code
+            for _ in range(3)
+        ]
+        assert codes == [OK, OK, OVER]
+        # The per-minute key rode the MAIN bank; the per-second key
+        # landed on bank1 (dual-bank gauges both live).
+        _grpc_call(r, _request("ps", [("perminute", "y")]))
+        r.cache.flush()
+        assert len(r.cache.per_second_engine.slot_table) == 1
+        assert len(r.cache.engine.slot_table) == 1
+        status, out = _http(r, "/stats", port=r.debug_server.bound_port)
+        assert status == 200
+        text = out.decode()
+        assert "ratelimit.tpu.bank0.live_keys: 1" in text
+        assert "ratelimit.tpu.bank1.live_keys: 1" in text
+    finally:
+        r.stop()
