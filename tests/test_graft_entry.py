@@ -1,0 +1,85 @@
+"""Hermeticity contract for the driver's multi-chip dry-run child.
+
+Three rounds of red MULTICHIP gates (r01-r03) each traced to the
+CPU child inheriting one more layer of the tunneled-TPU environment;
+_child_env is the pure function that owns the scrub, tested here
+without spawning a process. Reference analog: topology validation
+without production hardware (reference Makefile:74-102 runs the
+cluster tests against local redis processes).
+"""
+
+import importlib.util
+import os
+import sys
+
+_SPEC = importlib.util.spec_from_file_location(
+    "graft_entry_under_test",
+    os.path.join(os.path.dirname(__file__), "..", "__graft_entry__.py"),
+)
+graft = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(graft)
+
+
+def test_child_env_disables_axon_sitecustomize_trigger():
+    # The baked sitecustomize registers the (broken, libtpu-skewed)
+    # axon PJRT plugin whenever PALLAS_AXON_POOL_IPS is truthy. The
+    # child must present it EMPTY (not absent is fine too, but empty
+    # matches run-local.sh and survives `env` dumps unambiguously).
+    base = {
+        "PALLAS_AXON_POOL_IPS": "127.0.0.1",
+        "PALLAS_AXON_REMOTE_COMPILE": "1",
+        "PALLAS_AXON_TPU_GEN": "v5e",
+        "AXON_LOOPBACK_RELAY": "1",
+        "JAX_PLATFORMS": "axon",
+    }
+    env = graft._child_env(base, 8)
+    assert env["PALLAS_AXON_POOL_IPS"] == ""
+    for var in (
+        "PALLAS_AXON_REMOTE_COMPILE",
+        "PALLAS_AXON_TPU_GEN",
+        "AXON_LOOPBACK_RELAY",
+        "AXON_POOL_SVC_OVERRIDE",
+        "TPU_WORKER_HOSTNAMES",
+        "PJRT_NAMES_AND_LIBRARY_PATHS",
+        "JAX_PLATFORM_NAME",
+    ):
+        assert var not in env, var
+
+
+def test_child_env_forces_cpu_platform_and_device_count():
+    env = graft._child_env({"JAX_PLATFORMS": "axon"}, 8)
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "--xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+    assert env["RATELIMIT_TPU_DRYRUN_CHILD"] == "1"
+
+
+def test_child_env_replaces_stale_device_count_flag():
+    env = graft._child_env(
+        {"XLA_FLAGS": "--xla_force_host_platform_device_count=2 --xla_foo=1"},
+        8,
+    )
+    flags = env["XLA_FLAGS"].split()
+    assert "--xla_foo=1" in flags
+    assert "--xla_force_host_platform_device_count=8" in flags
+    assert "--xla_force_host_platform_device_count=2" not in flags
+
+
+def test_child_env_preserves_unrelated_vars():
+    env = graft._child_env({"HOME": "/root", "PATH": "/usr/bin"}, 4)
+    assert env["HOME"] == "/root"
+    assert env["PATH"] == "/usr/bin"
+
+
+def test_child_env_is_pure():
+    base = {"PALLAS_AXON_POOL_IPS": "127.0.0.1"}
+    graft._child_env(base, 8)
+    assert base == {"PALLAS_AXON_POOL_IPS": "127.0.0.1"}
+
+
+def test_parent_process_env_would_be_scrubbed():
+    # Belt-and-braces: whatever THIS process runs with, the derived
+    # child env must never carry a truthy axon trigger or a non-cpu
+    # platform selection.
+    env = graft._child_env(os.environ, 8)
+    assert not env.get("PALLAS_AXON_POOL_IPS")
+    assert env["JAX_PLATFORMS"] == "cpu"
