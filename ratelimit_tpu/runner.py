@@ -118,8 +118,17 @@ def create_limiter(s: Settings, stats_manager: Manager, local_cache, time_source
 
 
 class Runner:
-    def __init__(self, settings: Optional[Settings] = None):
+    def __init__(
+        self,
+        settings: Optional[Settings] = None,
+        time_source=None,
+    ):
+        # The clock seam: production uses the real clock; wire-level
+        # tests inject a pinned TimeSource so window-progression
+        # assertions can't straddle a minute rollover (the reference
+        # pins its clock the same way, test/service/ratelimit_test.go:72-76).
         self.settings = settings or new_settings()
+        self.time_source = time_source or RealTimeSource()
         self.stats_manager = Manager(extra_tags=self.settings.extra_tags)
         self._stopped = threading.Event()
         self.cache = None
@@ -176,7 +185,7 @@ class Runner:
             local_cache = LocalCache(s.local_cache_size_in_bytes)
             local_cache.register_stats(self.stats_manager.store)
 
-        time_source = RealTimeSource()
+        time_source = self.time_source
         self.cache = create_limiter(s, self.stats_manager, local_cache, time_source)
         if hasattr(self.cache, "register_stats"):
             self.cache.register_stats(self.stats_manager.store)

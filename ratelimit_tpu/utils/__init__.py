@@ -1,5 +1,6 @@
 from .time import (
     MonotonicBatchClock,
+    PinnedTimeSource,
     RealTimeSource,
     TimeSource,
     calculate_reset,
@@ -11,6 +12,7 @@ from .time import (
 __all__ = [
     "TimeSource",
     "RealTimeSource",
+    "PinnedTimeSource",
     "MonotonicBatchClock",
     "unit_to_divider",
     "calculate_reset",

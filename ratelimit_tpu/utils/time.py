@@ -68,6 +68,28 @@ class RealTimeSource(TimeSource):
         return int(time.time())
 
 
+class PinnedTimeSource(TimeSource):
+    """A clock pinned to a settable instant (reference MockClock
+    pattern, test/service/ratelimit_test.go:72-76).
+
+    First-class rather than test-only: wire-level tests inject it
+    through the Runner's clock seam so window-progression assertions
+    can never straddle a real second/minute rollover, and offline
+    tools (config_check replay, bench replay) use it to evaluate
+    limits at a fixed instant.
+    """
+
+    def __init__(self, now: int = 0):
+        self.now = int(now)
+
+    def advance(self, seconds: int) -> int:
+        self.now += int(seconds)
+        return self.now
+
+    def unix_now(self) -> int:
+        return self.now
+
+
 class MonotonicBatchClock(TimeSource):
     """A time source snapshotted once per batch.
 

@@ -25,18 +25,11 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 from ratelimit_tpu.stats.manager import Manager  # noqa: E402
-from ratelimit_tpu.utils.time import TimeSource  # noqa: E402
+from ratelimit_tpu.utils.time import PinnedTimeSource  # noqa: E402
 
-
-class FakeTimeSource(TimeSource):
-    """Pinned clock (reference test MockClock pattern,
-    test/service/ratelimit_test.go:72-76)."""
-
-    def __init__(self, now: int = 0):
-        self.now = now
-
-    def unix_now(self) -> int:
-        return self.now
+# Historical alias: the pinned clock is now first-class in
+# ratelimit_tpu.utils.time (injected through the Runner's clock seam).
+FakeTimeSource = PinnedTimeSource
 
 
 @pytest.fixture

@@ -13,6 +13,7 @@ import pytest
 from ratelimit_tpu.cluster.proxy import build_router, make_server
 from ratelimit_tpu.runner import Runner
 from ratelimit_tpu.settings import Settings
+from ratelimit_tpu.utils.time import PinnedTimeSource
 
 from ratelimit_tpu.server import pb  # noqa: F401
 from envoy.service.ratelimit.v3 import rls_pb2  # noqa: E402
@@ -52,7 +53,8 @@ def stack(tmp_path_factory):
                 runtime_subdirectory="ratelimit",
                 local_cache_size_in_bytes=0,
                 expiration_jitter_max_seconds=0,
-            )
+            ),
+            time_source=PinnedTimeSource(1_000_000),
         )
         r.start()
         runners.append(r)

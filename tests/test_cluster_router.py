@@ -18,6 +18,7 @@ from ratelimit_tpu.cluster.router import (
 )
 from ratelimit_tpu.runner import Runner
 from ratelimit_tpu.settings import Settings
+from ratelimit_tpu.utils.time import PinnedTimeSource
 
 from ratelimit_tpu.server import pb  # noqa: F401
 from envoy.service.ratelimit.v3 import rls_pb2  # noqa: E402
@@ -148,7 +149,7 @@ def replicas(tmp_path_factory):
             local_cache_size_in_bytes=0,
             expiration_jitter_max_seconds=0,
         )
-        r = Runner(settings)
+        r = Runner(settings, time_source=PinnedTimeSource(1_000_000))
         r.start()
         runners.append(r)
     yield runners
@@ -225,14 +226,9 @@ def test_concurrent_load_through_router_counts_exactly(replicas, router):
     splits and no lost updates."""
     import random
     import threading
-    import time
 
-    # The limiter is a real-time fixed window: a minute rollover
-    # mid-test would grant a fresh quota and break the exact-count
-    # assertion.  The burst takes ~2s; make sure it fits the window.
-    if 60 - (time.time() % 60) < 15:
-        time.sleep(60 - (time.time() % 60) + 0.5)
-
+    # The replicas run on a pinned clock (Runner time_source seam),
+    # so the fixed window can never roll mid-test.
     KEYS = [f"conc{i}" for i in range(6)]
     ok_counts = {k: 0 for k in KEYS}
     over_counts = {k: 0 for k in KEYS}

@@ -16,6 +16,7 @@ import pytest
 
 from ratelimit_tpu.runner import Runner
 from ratelimit_tpu.settings import Settings
+from ratelimit_tpu.utils.time import PinnedTimeSource
 
 from ratelimit_tpu.server import pb  # noqa: F401  (sys.path for generated)
 from envoy.service.ratelimit.v3 import rls_pb2  # noqa: E402
@@ -60,7 +61,10 @@ def runner(tmp_path_factory):
         local_cache_size_in_bytes=0,
         expiration_jitter_max_seconds=0,
     )
-    r = Runner(settings)
+    # Pinned clock through the Runner seam: window-progression
+    # assertions can't straddle a real second/minute rollover
+    # (reference MockClock, test/service/ratelimit_test.go:72-76).
+    r = Runner(settings, time_source=PinnedTimeSource(1_000_000))
     r.start()
     yield r
     r.stop()
@@ -489,7 +493,10 @@ def test_per_second_bank_wired_through_runner(tmp_path_factory):
             runtime_subdirectory="ratelimit",
             local_cache_size_in_bytes=0,
             expiration_jitter_max_seconds=0,
-        )
+        ),
+        # 2/SECOND progression: a real clock could roll the one-second
+        # window between calls.
+        time_source=PinnedTimeSource(1_000_000),
     )
     r.start()
     try:

@@ -12,6 +12,7 @@ import pytest
 
 from ratelimit_tpu.runner import Runner
 from ratelimit_tpu.settings import Settings
+from ratelimit_tpu.utils.time import PinnedTimeSource
 
 from ratelimit_tpu.server import pb  # noqa: F401
 from envoy.service.ratelimit.v3 import rls_pb2  # noqa: E402
@@ -57,7 +58,10 @@ def runner(tmp_path_factory):
             runtime_subdirectory="ratelimit",
             local_cache_size_in_bytes=0,
             expiration_jitter_max_seconds=0,
-        )
+        ),
+        # Pinned mid-window: progression assertions (4 OK then OVER)
+        # must never straddle a real minute rollover.
+        time_source=PinnedTimeSource(1_000_000),
     )
     r.start()
     yield r
@@ -167,7 +171,8 @@ def test_sharded_write_behind_backend(tmp_path_factory):
             runtime_subdirectory="ratelimit",
             local_cache_size_in_bytes=0,
             expiration_jitter_max_seconds=0,
-        )
+        ),
+        time_source=PinnedTimeSource(1_000_000),
     )
     r.start()
     try:

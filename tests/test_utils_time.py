@@ -46,3 +46,15 @@ def test_monotonic_batch_clock(clock):
     assert batch_clock.unix_now() == 1234
     assert batch_clock.snapshot() == 2000
     assert batch_clock.unix_now() == 2000
+
+
+def test_pinned_time_source_advance():
+    from ratelimit_tpu.utils.time import PinnedTimeSource
+
+    c = PinnedTimeSource(100)
+    assert c.unix_now() == 100
+    assert c.advance(61) == 161
+    assert c.unix_now() == 161
+    # Window math moves with the pin: advancing past a minute boundary
+    # rolls the MINUTE window exactly once.
+    assert window_start(100, Unit.MINUTE) != window_start(161, Unit.MINUTE)
