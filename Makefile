@@ -6,10 +6,12 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
 .PHONY: all test bench protos native serve check_config smoke_client docker_image e2e e2e-local clean
 
-# C++ slot table (auto-built on first import too; this forces it).
+# C++ hot-path library: slot table + decide kernel (auto-built on
+# first import too; this forces it).
 native:
 	g++ -O2 -std=c++20 -shared -fPIC \
-	  -o ratelimit_tpu/backends/_libslottable.so native/slot_table.cpp
+	  -o ratelimit_tpu/backends/_libslottable.so \
+	  native/slot_table.cpp native/decide.cpp
 
 all: test
 

@@ -142,7 +142,7 @@ class FlatMap {
     state_[i] = kFull;
     hashes_[i] = h;
     Meta& m = meta_[i];
-    m.key_off = static_cast<uint32_t>(arena_.size());
+    m.key_off = static_cast<uint64_t>(arena_.size());
     m.key_len = static_cast<uint32_t>(key.size());
     m.slot = slot;
     m.expiry = expiry;
@@ -177,7 +177,10 @@ class FlatMap {
  private:
   static constexpr uint8_t kEmpty = 0, kFull = 1, kTombstone = 2;
   struct Meta {
-    uint32_t key_off;
+    // 64-bit offset: a u32 offset would silently wrap once ~4 GiB of
+    // key bytes accumulate in the arena (tombstones included before
+    // compaction), aliasing key comparisons onto wrong bytes.
+    uint64_t key_off;
     uint32_t key_len;
     int64_t slot;
     int64_t expiry;

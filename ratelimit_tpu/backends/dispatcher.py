@@ -119,6 +119,17 @@ class WorkItem:
     # (write-behind drains its pending-hit accounting here; a silent
     # skip would inflate its decisions for the rest of the window).
     on_error: Optional[Callable[[BaseException], None]] = None
+    # True (sync serving path): the completer only parks a
+    # (batch_decisions, lo, hi) reference in `result` and signals;
+    # slicing + apply() then run inside wait() on the waiting RPC
+    # thread.  Status assembly AND per-item slicing were the
+    # completer's largest serial legs (~4ms + ~4ms per 4096-lane/1024-
+    # item batch, benchmarks/results/host_path.json) — on waiter
+    # threads they parallelize across the RPC pool and overlap the
+    # next batch's launch.  Backends that never wait (write-behind)
+    # keep the default: their apply still runs on the completer.
+    defer_apply: bool = False
+    result: Optional[tuple] = None  # (HostDecisions, lo, hi)
     event: threading.Event = field(default_factory=threading.Event)
     error: Optional[BaseException] = None
 
@@ -152,6 +163,13 @@ class WorkItem:
             )
         if self.error is not None:
             raise self.error
+        if self.defer_apply and self.result is not None:
+            # Deferred slicing + status assembly: runs HERE, on the
+            # waiting RPC thread (see defer_apply).  apply() errors
+            # propagate to the caller exactly like completer-side
+            # apply errors.
+            (decisions, lo, hi), self.result = self.result, None
+            self.apply(_slice(decisions, lo, hi))
 
 
 class _FlushToken:
@@ -183,8 +201,18 @@ class DispatcherDead(RuntimeError):
 
 
 def _slice(d: HostDecisions, lo: int, hi: int) -> HostDecisions:
+    # Positional construction (field order = dataclass order): this
+    # runs per waiting request, so no getattr/dict-comprehension.
     return HostDecisions(
-        **{f: getattr(d, f)[lo:hi] for f in HostDecisions.__dataclass_fields__}
+        d.codes[lo:hi],
+        d.limit_remaining[lo:hi],
+        d.befores[lo:hi],
+        d.afters[lo:hi],
+        d.over_limit[lo:hi],
+        d.near_limit[lo:hi],
+        d.within_limit[lo:hi],
+        d.shadow_mode[lo:hi],
+        d.set_local_cache[lo:hi],
     )
 
 
@@ -244,16 +272,6 @@ def complete_items(engine, items: List[WorkItem], token) -> bool:
         return False  # submit already errored the items
     try:
         decisions = engine.step_complete(token)
-        # One .tolist() per field up front: per-lane reads in the apply
-        # callbacks become plain-int list indexing instead of numpy
-        # scalar extraction (~10x cheaper across a 4096-lane batch —
-        # benchmarks/results/host_path.json status_assembly_loop).
-        decisions = HostDecisions(
-            **{
-                f: getattr(decisions, f).tolist()
-                for f in HostDecisions.__dataclass_fields__
-            }
-        )
     except BaseException as e:
         for it in items:
             it.fail(e)
@@ -261,11 +279,18 @@ def complete_items(engine, items: List[WorkItem], token) -> bool:
     off = 0
     for it in items:
         n = it.n_lanes
-        try:
-            it.apply(_slice(decisions, off, off + n))
-        except BaseException as e:
-            it.error = e
-        off += n
+        end = off + n
+        if it.defer_apply:
+            # Park a reference + bounds; the waiting RPC thread does
+            # the slicing, list conversion and apply after event.set —
+            # the completer's serial leg is just signalling.
+            it.result = (decisions, off, end)
+        else:
+            try:
+                it.apply(_slice(decisions, off, end))
+            except BaseException as e:
+                it.error = e
+        off = end
         it.event.set()
     return True
 

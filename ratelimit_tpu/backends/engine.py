@@ -142,6 +142,23 @@ def _decode_keys(blob, lens: np.ndarray) -> List[str]:
     return keys
 
 
+_NATIVE_DECIDE = None  # resolved on first use: False, or the fn
+
+
+def _native_decide_fn():
+    """The C++ fused decide kernel, or None (resolved once)."""
+    global _NATIVE_DECIDE
+    if _NATIVE_DECIDE is None:
+        from . import native_slot_table
+
+        _NATIVE_DECIDE = (
+            native_slot_table.decide_reconstruct
+            if native_slot_table.available()
+            else False
+        )
+    return _NATIVE_DECIDE or None
+
+
 def _decide_host(
     afters_padded: np.ndarray,
     hits_u32: np.ndarray,
@@ -174,6 +191,42 @@ def _decide_host(
       answering OK — the counter cannot count higher, which is also
       where a limit that large stops being a limit."""
     from ..limiter.base import decide_batch
+
+    if dedup is not None:
+        native = _native_decide_fn()
+        if native is not None:
+            # Fused C pass: reconstruction + threshold machine in one
+            # call (native/decide.cpp), differential-locked to the
+            # numpy path below by tests/test_native_decide.py.
+            from ..api import Code
+
+            g = len(dedup.uniq_slots)
+            (
+                codes, remaining, befores, afters,
+                over, near, within, shadow_d, set_lc,
+            ) = native(
+                afters_padded[:g],
+                dedup.totals,
+                dedup.inv,
+                dedup.prefix,
+                hits_u32,
+                limits_u32,
+                shadow,
+                near_ratio,
+                int(Code.OK),
+                int(Code.OVER_LIMIT),
+            )
+            return HostDecisions(
+                codes=codes,
+                limit_remaining=remaining,
+                befores=befores,
+                afters=afters,
+                over_limit=over,
+                near_limit=near,
+                within_limit=within,
+                shadow_mode=shadow_d,
+                set_local_cache=set_lc,
+            )
 
     U32_MAX = np.uint64(0xFFFFFFFF)
     count = len(hits_u32)

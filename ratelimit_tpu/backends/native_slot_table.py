@@ -28,16 +28,19 @@ _LIB: Optional[ctypes.CDLL] = None
 _LIB_LOCK = threading.Lock()
 _LIB_FAILED = False
 
-_SRC = os.path.join(
+_NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
     "native",
-    "slot_table.cpp",
 )
+_SRCS = [
+    os.path.join(_NATIVE_DIR, "slot_table.cpp"),
+    os.path.join(_NATIVE_DIR, "decide.cpp"),
+]
 _SO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_libslottable.so")
 
 
 def _build() -> bool:
-    if not os.path.exists(_SRC):
+    if not all(os.path.exists(s) for s in _SRCS):
         return False
     # Build to a temp path + atomic rename: concurrent processes never
     # dlopen a half-written .so, and a rebuild never truncates a file
@@ -45,7 +48,8 @@ def _build() -> bool:
     tmp = f"{_SO}.tmp.{os.getpid()}"
     try:
         subprocess.run(
-            ["g++", "-O2", "-std=c++20", "-shared", "-fPIC", "-o", tmp, _SRC],
+            ["g++", "-O2", "-std=c++20", "-shared", "-fPIC", "-o", tmp]
+            + _SRCS,
             check=True,
             capture_output=True,
             timeout=120,
@@ -93,6 +97,13 @@ def _signatures(lib: ctypes.CDLL) -> None:
     lib.sk_export.argtypes = [ctypes.c_void_p, u8p, i64p, i64p, i64p]
     lib.sk_import.restype = i64
     lib.sk_import.argtypes = [ctypes.c_void_p, u8p, i64p, i64p, i64p, i64]
+    lib.sk_decide_reconstruct.restype = None
+    lib.sk_decide_reconstruct.argtypes = [
+        u32p, u64p, i64,  # afters_g, totals, g
+        i32p, u64p, u32p, u32p, u8p, i64,  # inv, prefix, hits, limits, shadow, n
+        ctypes.c_float, ctypes.c_int32, ctypes.c_int32,  # ratio, codes
+        i32p, i64p, i64p, i64p, i64p, i64p, i64p, i64p, u8p,  # outputs
+    ]
 
 
 def _get_lib() -> Optional[ctypes.CDLL]:
@@ -102,9 +113,9 @@ def _get_lib() -> Optional[ctypes.CDLL]:
     with _LIB_LOCK:
         if _LIB is not None or _LIB_FAILED:
             return _LIB
-        if not os.path.exists(_SO) or (
-            os.path.exists(_SRC)
-            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+        if not os.path.exists(_SO) or any(
+            os.path.exists(s) and os.path.getmtime(s) > os.path.getmtime(_SO)
+            for s in _SRCS
         ):
             if not _build():
                 _LIB_FAILED = True
@@ -113,7 +124,18 @@ def _get_lib() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(_SO)
             _signatures(lib)
             _LIB = lib
-        except OSError as e:
+        except (OSError, AttributeError) as e:
+            # AttributeError: a stale .so (newer mtime than the
+            # sources, e.g. a cached build artifact) loaded but lacks
+            # a newer symbol — rebuild once, then fall back to Python.
+            if _build():
+                try:
+                    lib = ctypes.CDLL(_SO)
+                    _signatures(lib)
+                    _LIB = lib
+                    return _LIB
+                except (OSError, AttributeError):
+                    pass
             logger.warning("native slot table load failed (%s); using Python", e)
             _LIB_FAILED = True
     return _LIB
@@ -136,6 +158,85 @@ def _i64p(a: np.ndarray):
 
 def _u8p(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def decide_reconstruct(
+    afters_g: np.ndarray,
+    totals: np.ndarray,
+    inv: np.ndarray,
+    prefix: np.ndarray,
+    hits: np.ndarray,
+    limits: np.ndarray,
+    shadow: np.ndarray,
+    near_ratio: float,
+    ok_code: int,
+    over_code: int,
+):
+    """One C pass over a deduped chunk: per-lane before/after
+    reconstruction from per-group device afters + the threshold state
+    machine (native/decide.cpp — the fused mirror of
+    engine._decide_host + limiter.base.decide_batch).
+
+    Returns (codes i32, remaining i64, befores i64, afters i64,
+    over i64, near i64, within i64, shadow i64, set_lc bool), all
+    length n.  Caller guarantees the lib is available.
+    """
+    lib = _get_lib()
+    n = len(hits)
+    g = len(afters_g)
+    afters_g = np.ascontiguousarray(afters_g, dtype=np.uint32)
+    totals = np.ascontiguousarray(totals, dtype=np.uint64)
+    inv = np.ascontiguousarray(inv, dtype=np.int32)
+    prefix = np.ascontiguousarray(prefix, dtype=np.uint64)
+    hits = np.ascontiguousarray(hits, dtype=np.uint32)
+    limits = np.ascontiguousarray(limits, dtype=np.uint32)
+    shadow = np.ascontiguousarray(shadow, dtype=np.uint8)
+    out_codes = np.empty(n, dtype=np.int32)
+    out_remaining = np.empty(n, dtype=np.int64)
+    out_befores = np.empty(n, dtype=np.int64)
+    out_afters = np.empty(n, dtype=np.int64)
+    out_over = np.empty(n, dtype=np.int64)
+    out_near = np.empty(n, dtype=np.int64)
+    out_within = np.empty(n, dtype=np.int64)
+    out_shadow = np.empty(n, dtype=np.int64)
+    out_set_lc = np.empty(n, dtype=np.bool_)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.sk_decide_reconstruct(
+        afters_g.ctypes.data_as(u32p),
+        totals.ctypes.data_as(u64p),
+        g,
+        inv.ctypes.data_as(i32p),
+        prefix.ctypes.data_as(u64p),
+        hits.ctypes.data_as(u32p),
+        limits.ctypes.data_as(u32p),
+        _u8p(shadow),
+        n,
+        ctypes.c_float(near_ratio),
+        int(ok_code),
+        int(over_code),
+        out_codes.ctypes.data_as(i32p),
+        _i64p(out_remaining),
+        _i64p(out_befores),
+        _i64p(out_afters),
+        _i64p(out_over),
+        _i64p(out_near),
+        _i64p(out_within),
+        _i64p(out_shadow),
+        _u8p(out_set_lc),
+    )
+    return (
+        out_codes,
+        out_remaining,
+        out_befores,
+        out_afters,
+        out_over,
+        out_near,
+        out_within,
+        out_shadow,
+        out_set_lc,
+    )
 
 
 class NativeSlotTable:

@@ -420,7 +420,12 @@ class TpuRateLimitCache:
                 rows, keys, limits, hits_addend, now, decisions, statuses
             )
 
-        return WorkItem(now=now, lanes=(), pack=pack, apply=apply)
+        # defer_apply: status assembly runs on THIS RPC thread inside
+        # item.wait(), not on the dispatcher's completer — it was the
+        # completer's largest serial leg (host_path.json).
+        return WorkItem(
+            now=now, lanes=(), pack=pack, apply=apply, defer_apply=True
+        )
 
     def _apply_decisions(
         self,
@@ -432,18 +437,19 @@ class TpuRateLimitCache:
         decisions: HostDecisions,
         statuses: List[Optional[DescriptorStatus]],
     ) -> None:
-        # `decisions` fields are plain Python lists here (one tolist()
-        # per batch in dispatcher.complete_items), so every read below
-        # is list indexing on ints — no numpy scalar extraction.  Stat
+        # One tolist() per field up front (on THIS thread — the RPC
+        # waiter under defer_apply): per-lane reads below become plain
+        # list indexing on ints, ~10x cheaper than numpy scalar
+        # extraction across a 4096-lane batch (host_path.json).  Stat
         # adds skip zero deltas (most lanes touch exactly one stat).
         reset_cache: dict = {}
-        codes = decisions.codes
-        remaining = decisions.limit_remaining
-        over = decisions.over_limit
-        near = decisions.near_limit
-        within = decisions.within_limit
-        shadow = decisions.shadow_mode
-        set_lc = decisions.set_local_cache
+        codes = decisions.codes.tolist()
+        remaining = decisions.limit_remaining.tolist()
+        over = decisions.over_limit.tolist()
+        near = decisions.near_limit.tolist()
+        within = decisions.within_limit.tolist()
+        shadow = decisions.shadow_mode.tolist()
+        set_lc = decisions.set_local_cache.tolist()
         local_cache = self.local_cache
         for j, i in enumerate(rows):
             rule = limits[i]

@@ -1,0 +1,185 @@
+"""Differential lock: the C++ fused decide kernel (native/decide.cpp)
+vs the Python oracle (engine._decide_host -> limiter.base.decide_batch)
+— same contract as the native slot table vs its Python spec.
+
+Covers the regimes the kernel has to reproduce exactly: multi-hit
+threshold straddling (reference base_limiter.go:150-179), shadow mode,
+duplicate-key groups with pipeline-order prefixes, narrow compact
+readbacks (u8/u16), and both saturation regimes (_decide_host's
+docstring)."""
+
+import numpy as np
+import pytest
+
+from ratelimit_tpu.backends import native_slot_table
+from ratelimit_tpu.backends.engine import _Dedup, _decide_host, _dedup_chunk
+
+pytestmark = pytest.mark.skipif(
+    not native_slot_table.available(), reason="native library unavailable"
+)
+
+
+def _python_oracle(afters_g, hits, limits, shadow, near_ratio, dedup):
+    """The pure-numpy path, with the native fast path forced off."""
+    import ratelimit_tpu.backends.engine as eng
+
+    saved = eng._NATIVE_DECIDE
+    eng._NATIVE_DECIDE = False
+    try:
+        return _decide_host(afters_g, hits, limits, shadow, near_ratio, dedup)
+    finally:
+        eng._NATIVE_DECIDE = saved
+
+
+def _native(afters_g, hits, limits, shadow, near_ratio, dedup):
+    import ratelimit_tpu.backends.engine as eng
+
+    saved = eng._NATIVE_DECIDE
+    eng._NATIVE_DECIDE = None  # re-resolve -> native
+    try:
+        out = _decide_host(afters_g, hits, limits, shadow, near_ratio, dedup)
+        assert eng._NATIVE_DECIDE is not False, "native kernel did not load"
+        return out
+    finally:
+        eng._NATIVE_DECIDE = saved
+
+
+def _assert_equal(a, b):
+    for f in (
+        "codes",
+        "limit_remaining",
+        "befores",
+        "afters",
+        "over_limit",
+        "near_limit",
+        "within_limit",
+        "shadow_mode",
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, f), dtype=np.int64),
+            np.asarray(getattr(b, f), dtype=np.int64),
+            err_msg=f,
+        )
+    np.testing.assert_array_equal(
+        np.asarray(a.set_local_cache, dtype=bool),
+        np.asarray(b.set_local_cache, dtype=bool),
+        err_msg="set_local_cache",
+    )
+
+
+def _run_case(slots, hits, limits, shadow, device_counts, near_ratio=0.8):
+    """Simulate the device step for a batch and compare both hosts.
+
+    `device_counts` maps slot -> counter value BEFORE this batch."""
+    slots = np.asarray(slots, dtype=np.int32)
+    hits = np.asarray(hits, dtype=np.uint32)
+    limits = np.asarray(limits, dtype=np.uint32)
+    shadow = np.asarray(shadow, dtype=bool)
+    dedup = _dedup_chunk(slots, hits, limits, np.zeros(len(slots), bool))
+    # Saturating per-group device afters, like the device kernel.
+    afters_g = np.empty(len(dedup.uniq_slots), dtype=np.uint32)
+    for k, s in enumerate(dedup.uniq_slots):
+        before = np.uint64(device_counts.get(int(s), 0))
+        total = dedup.totals[k]
+        afters_g[k] = min(int(before) + int(total), 0xFFFFFFFF)
+    py = _python_oracle(afters_g, hits, limits, shadow, near_ratio, dedup)
+    nat = _native(afters_g, hits, limits, shadow, near_ratio, dedup)
+    _assert_equal(nat, py)
+    return nat
+
+
+def test_basic_progression():
+    # One key, limit 4: five single hits cross the limit.
+    for before in range(6):
+        _run_case([7], [1], [4], [False], {7: before})
+
+
+def test_multi_hit_straddle():
+    # hits=5 straddles both near (8) and over (10) thresholds.
+    for before in (0, 4, 6, 7, 8, 9, 10, 12):
+        _run_case([3], [5], [10], [False], {3: before})
+
+
+def test_shadow_mode_flip():
+    d = _run_case([1], [10], [2], [True], {1: 50})
+    assert int(np.asarray(d.codes)[0]) == 1  # OK despite over
+    assert int(np.asarray(d.shadow_mode)[0]) == 10
+    assert bool(np.asarray(d.set_local_cache)[0])  # marker survives
+
+
+def test_duplicate_groups_pipeline_order():
+    # Three lanes on one slot + two on another, mixed hits: prefixes
+    # must reproduce per-lane befores in batch order.
+    _run_case(
+        [5, 9, 5, 5, 9],
+        [2, 3, 1, 4, 1],
+        [6, 6, 6, 6, 6],
+        [False] * 5,
+        {5: 1, 9: 4},
+    )
+
+
+def test_u32_saturation_fully_over():
+    # Counter lapped: device returns u32 max; every lane fully-over.
+    d = _run_case([2], [3], [100], [False], {2: 0xFFFFFFFF})
+    assert int(np.asarray(d.over_limit)[0]) == 3
+    assert int(np.asarray(d.codes)[0]) == 2
+
+
+def test_narrow_readback_dtypes():
+    # Compact u8/u16 readbacks widen exactly.
+    slots = np.array([0, 1], dtype=np.int32)
+    hits = np.array([1, 1], dtype=np.uint32)
+    limits = np.array([10, 10], dtype=np.uint32)
+    shadow = np.zeros(2, bool)
+    dedup = _dedup_chunk(slots, hits, limits, np.zeros(2, bool))
+    for dt in (np.uint8, np.uint16, np.uint32):
+        afters_g = np.array([5, 11], dtype=dt)
+        py = _python_oracle(afters_g, hits, limits, shadow, 0.8, dedup)
+        nat = _native(afters_g, hits, limits, shadow, 0.8, dedup)
+        _assert_equal(nat, py)
+
+
+def test_float32_near_threshold_edges():
+    # Limits where float32 rounding of limit*ratio matters.
+    for limit in (1, 3, 5, 7, 10, 16777217, 100000007, 0xFFFFFFFF):
+        for ratio in (0.8, 0.5, 0.9999, 0.1):
+            for before in (0, limit // 2, max(0, limit - 1), limit):
+                _run_case(
+                    [0],
+                    [1],
+                    [limit],
+                    [False],
+                    {0: min(before, 0xFFFFFFFF)},
+                    near_ratio=ratio,
+                )
+
+
+def test_randomized_batches():
+    rng = np.random.default_rng(42)
+    for trial in range(20):
+        n = int(rng.integers(1, 300))
+        slots = rng.integers(0, 40, n).astype(np.int32)
+        hits = rng.integers(1, 50, n).astype(np.uint32)
+        limits = rng.integers(1, 100, n).astype(np.uint32)
+        shadow = rng.random(n) < 0.2
+        counts = {
+            int(s): int(rng.integers(0, 120)) for s in np.unique(slots)
+        }
+        # Sprinkle saturated counters.
+        if trial % 4 == 0:
+            for s in list(counts)[:2]:
+                counts[s] = 0xFFFFFFFF - int(rng.integers(0, 3))
+        _run_case(slots, hits, limits, shadow, counts)
+
+
+def test_huge_hits_saturate_after():
+    # befores + huge hits pins after at u32 max (clamped, not wrapped).
+    _run_case([4], [0xFFFFFFFF], [10], [False], {4: 100})
+    _run_case(
+        [4, 4],
+        [0xFFFFFFFF, 0xFFFFFFFF],
+        [10, 10],
+        [False, False],
+        {4: 0},
+    )

@@ -354,3 +354,37 @@ def test_extreme_hits_never_reset_enforcement(wb, clock):
     assert st.code == Code.OVER_LIMIT, "reconciled view must stay over"
     # Device counter saturated, not wrapped.
     assert int(wb.engine.export_counts().max()) == 0xFFFFFFFF
+
+
+def test_dead_dispatcher_submit_drains_pending(clock):
+    """ADVICE r3: when dispatcher.submit itself raises (dispatcher
+    dead), the pending hits this call already added to the view must
+    drain in the except branch — on_error never fires for an item
+    that never reached the queue."""
+    wb = WriteBehindRateLimitCache(
+        CounterEngine(num_slots=256, buckets=(8, 32)),
+        time_source=clock,
+        batch_window_us=100,
+    )
+    mgr = Manager()
+    cfg = _cfg(mgr)
+    try:
+        req = _req([[("k", "deadsub")]])
+        lim = _limits(cfg, req)
+        wb.do_limit(req, lim)
+        wb.flush()  # 1 committed hit
+        # Kill the dispatcher: subsequent submits raise DispatcherDead.
+        wb._dispatcher.stop()
+        from ratelimit_tpu.backends.dispatcher import DispatcherDead
+
+        wb._dispatcher._dead = DispatcherDead("stopped for test")
+        from ratelimit_tpu.service import CacheError
+
+        with pytest.raises(CacheError):
+            wb.do_limit(req, lim)
+        key = next(iter(wb._view))
+        dev, pending, _ = wb._view[key]
+        assert pending == 0, "raising submit leaked pending hits"
+        assert dev == 1
+    finally:
+        wb.close()
