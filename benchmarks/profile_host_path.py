@@ -199,21 +199,69 @@ def main():
     results["submit_plus_complete_with_status_assembly"] = t_rta
     results["status_assembly"] = t_rta - t_rt
 
+    # Round-4 serving split (defer_apply=True): the completer only
+    # parks per-item decision slices + signals; status assembly runs
+    # on the waiting RPC threads (item.wait -> apply), where it
+    # parallelizes across the handler pool and overlaps the next
+    # batch.  Measure both legs separately.
+    its_defer = make_items(engine, 4, apply=apply)
+    for it in its_defer:
+        it.defer_apply = True
+    tok = submit_items(engine, its_defer)
+    complete_items(engine, its_defer, tok)  # warm
+    for it in its_defer:
+        it.wait(5)
+        it.event.clear()
+
+    def rt_defer():
+        token = submit_items(engine, its_defer)
+        return complete_items(engine, its_defer, token)
+
+    t_rtd, _ = timed(rt_defer)
+    # timed() left one completed round parked; drain + measure the
+    # RPC-side leg (serial here; spread over handler threads in
+    # serving).  The lists-from-views conversion happens inside apply
+    # via tolist on each item's slice.
+    def drain_waits():
+        for it in its_defer:
+            it.wait(5)
+            it.event.clear()
+        return None
+
+    t_wait, _ = timed(
+        lambda: (rt_defer(), drain_waits())[1], reps=10
+    )
+    results["serving_completer_per_batch"] = t_rtd - results["submit_total"]
+    results["deferred_assembly_rpc_side"] = max(0.0, t_wait - t_rtd)
+
     collector = results["submit_total"]
-    completer = results["submit_plus_complete_with_status_assembly"] - collector
+    completer = results["serving_completer_per_batch"]
+    assembly = results["deferred_assembly_rpc_side"]
     results["collector_serial_per_batch"] = collector
     results["completer_per_batch"] = completer
     results["max_batches_per_sec_collector"] = 1.0 / collector
-    results["implied_decisions_per_sec_host"] = BATCH / collector
+    # Two capacity numbers, both honest: the pipelined bound assumes
+    # the collector, completer and RPC handler threads each have their
+    # own core (the deferred-assembly leg spreads over the handler
+    # pool); the 1-core bound sums every leg — the assembly work moved
+    # off the completer, it did not disappear.
+    results["implied_decisions_per_sec_pipelined"] = BATCH / max(
+        collector, completer
+    )
+    results["implied_decisions_per_sec_one_core"] = BATCH / (
+        collector + completer + assembly
+    )
 
     out = {
         "batch": BATCH,
         "requests": REQUESTS,
         "dup_keys": DUP_KEYS,
         "note": (
-            "round-3 packed pipeline: LanePack on RPC threads, fused "
-            "C++ assign+dedup, single (4,N) int32 transfer, tolist "
-            "status assembly; 1-core host, CPU platform"
+            "round-4 pipeline: LanePack on RPC threads, fused C++ "
+            "assign+dedup, single (4,N) int32 transfer, fused C++ "
+            "decide+reconstruct (native/decide.cpp), deferred status "
+            "assembly on RPC threads (defer_apply); 1-core host, CPU "
+            "platform"
         ),
         "phases_seconds": results,
     }

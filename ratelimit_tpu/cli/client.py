@@ -8,6 +8,7 @@
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 import grpc
@@ -58,7 +59,14 @@ def main(argv=None) -> int:
         except grpc.RpcError as e:
             print(f"error: {e.code().name}: {e.details()}", file=sys.stderr)
             return 1
-    print(response)
+    try:
+        print(response)
+        sys.stdout.flush()
+    except BrokenPipeError:
+        # Downstream (head/grep -q) closed the pipe after reading what
+        # it needed — that is success, not a crash.
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
     return 0
 
 
