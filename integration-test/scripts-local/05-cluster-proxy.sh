@@ -155,3 +155,46 @@ done
 r3_keys=$(curl -s http://localhost:39070/stats | grep "ratelimit.tpu.bank0.live_keys" | grep -o "[0-9]*$")
 [ "$r3_keys" -ge 1 ] 2>/dev/null || { echo "new replica never received a key (live_keys=$r3_keys)"; exit 1; }
 echo ok-membership
+
+# --- phase 3: replica failover (r4 VERDICT next #5) ---
+# SIGKILL one of the three replicas: the proxy must keep serving ALL
+# keys — descriptors owned by the dead replica re-own to survivors
+# (their windows restart: the documented amnesia envelope), and the
+# proxy ejects it after consecutive connection failures.
+R3_PID=""
+for p in $PIDS; do
+  if [ -d "/proc/$p" ] && grep -q "GRPC_PORT=39081" "/proc/$p/environ" 2>/dev/null; then
+    R3_PID=$p
+  fi
+done
+# Fallback: match by port listener via environ is linux-only; if not
+# found, pick the runner started last (r3 was the most recent runner).
+if [ -z "$R3_PID" ]; then
+  for p in $PIDS; do
+    if ps -o cmd= -p "$p" 2>/dev/null | grep -q "ratelimit_tpu.runner"; then
+      R3_PID=$p  # last runner pid wins
+    fi
+  done
+fi
+[ -n "$R3_PID" ] || { echo "could not locate r3 pid"; exit 1; }
+kill -9 "$R3_PID"
+
+# Every key keeps answering through the proxy (survivors absorb the
+# dead replica's keyspace; the first hits on a dead owner fail over
+# transparently inside one request).
+fails=0
+for i in $(seq 1 30); do
+  "${PY:-python}" -m ratelimit_tpu.cli.client \
+    --dial_string 127.0.0.1:29090 --domain rl --descriptors "foo=failover$i" \
+    >/dev/null 2>&1 || fails=$((fails + 1))
+done
+[ "$fails" = "0" ] || { echo "$fails/30 requests failed after replica kill"; tail -8 "$RL/proxy2.log"; exit 1; }
+
+# The proxy observed the death and ejected the replica.
+ejected=0
+for i in $(seq 1 10); do
+  if grep -q "ejected after" "$RL/proxy2.log"; then ejected=1; break; fi
+  sleep 1
+done
+[ "$ejected" = "1" ] || { echo "dead replica never ejected"; tail -8 "$RL/proxy2.log"; exit 1; }
+echo ok-failover

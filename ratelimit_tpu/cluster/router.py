@@ -39,6 +39,8 @@ can never stretch the total past the caller's deadline.
 from __future__ import annotations
 
 import hashlib
+import logging
+import threading
 from concurrent.futures import ThreadPoolExecutor
 import time
 from typing import Dict, List, Optional, Protocol, Sequence
@@ -46,6 +48,8 @@ from typing import Dict, List, Optional, Protocol, Sequence
 from ..server import pb  # noqa: F401  (sys.path for generated protos)
 
 from envoy.service.ratelimit.v3 import rls_pb2  # noqa: E402
+
+logger = logging.getLogger("ratelimit.cluster.router")
 
 
 def routing_key(domain: str, descriptor) -> str:
@@ -86,6 +90,89 @@ class DeadlineExceededError(RuntimeError):
     the proxy maps this to gRPC DEADLINE_EXCEEDED."""
 
 
+class _ReplicaCallError(RuntimeError):
+    """One replica sub-call failed with a REPLICA-health error (not an
+    application status like INVALID_ARGUMENT, which propagates)."""
+
+    def __init__(self, index: int, replica_id: str, cause: BaseException):
+        super().__init__(f"replica {replica_id} failed: {cause!r}")
+        self.index = index
+        self.replica_id = replica_id
+        self.cause = cause
+
+
+# gRPC status names that indicate the REPLICA (or the path to it) is
+# unreachable — these count toward ejection and trigger failover:
+# UNAVAILABLE is a dead/refused connection; DEADLINE_EXCEEDED is a
+# hang, but ONLY when the timeout that expired was a generous one (see
+# _HANG_MIN_BUDGET_S below) — a tight CALLER deadline expiring against
+# a merely-slow replica must not eject it.  Everything else is the
+# replica ANSWERING — application statuses (UNKNOWN on an empty
+# domain, INVALID_ARGUMENT, PERMISSION_DENIED, even a backend
+# CacheError surfaced as UNKNOWN) propagate untouched, matching the
+# reference, whose sentinel failover is driven by connection errors
+# only (driver_impl.go:108-126), never by command errors.
+_FAILURE_STATUS_NAMES = frozenset({"UNAVAILABLE", "DEADLINE_EXCEEDED"})
+
+# A DEADLINE_EXCEEDED counts as a replica HANG (ejectable) only when
+# the expired timeout was at least this long.  Below it, the caller's
+# own tight budget is indistinguishable from a slow replica, and
+# counting it would let short-deadline clients eject healthy replicas
+# one by one until the proxy reports NOT_SERVING.
+_HANG_MIN_BUDGET_S = 5.0
+
+
+def _failure_status_name(exc: BaseException) -> Optional[str]:
+    """The gRPC status name if `exc` carries one, else None."""
+    code = getattr(exc, "code", None)
+    if callable(code):
+        try:
+            return code().name
+        except Exception:
+            return None
+    return None
+
+
+def _is_replica_failure(
+    exc: BaseException, effective_timeout_s: float
+) -> bool:
+    """`effective_timeout_s` is the timeout that could actually have
+    expired: min(caller budget, transport ceiling)."""
+    name = _failure_status_name(exc)
+    if name is None:
+        # Non-gRPC transport exceptions (socket errors, in-process
+        # fakes raising RuntimeError): replica failure.
+        return True
+    if name == "DEADLINE_EXCEEDED":
+        return effective_timeout_s >= _HANG_MIN_BUDGET_S
+    return name in _FAILURE_STATUS_NAMES
+
+
+class _Circuit:
+    """Per-replica circuit breaker (the sentinel-failover analog,
+    reference src/redis/driver_impl.go:108-126: a dead node is ejected
+    from the pool and traffic re-resolves to the survivors).
+
+    closed  -> serving normally;
+    open    -> ejected from the rendezvous set (keys re-own to the
+               survivors; their windows restart — the documented
+               amnesia envelope, docs/MULTI_REPLICA.md);
+    half-open -> after ``readmit_after_s`` the replica re-enters the
+               candidate set; the next real sub-call is the probe —
+               success closes the circuit, failure re-arms it.
+    """
+
+    __slots__ = ("failures", "is_open", "retry_at", "probe_until")
+
+    def __init__(self):
+        self.failures = 0
+        self.is_open = False
+        self.retry_at = 0.0
+        # While now < probe_until, one request holds the half-open
+        # probe claim; concurrent requests route around the replica.
+        self.probe_until = 0.0
+
+
 class Transport(Protocol):
     """One replica endpoint.  `timeout_s` is the time REMAINING in
     the caller's budget when this call starts (None = no deadline);
@@ -111,15 +198,39 @@ class ReplicaRouter:
         replica_ids: Sequence[str],
         transports: Sequence[Transport],
         max_workers: int = 8,
+        eject_after: int = 3,
+        readmit_after_s: float = 5.0,
+        failure_policy: str = "open",
+        transport_ceiling_s: float = 30.0,
     ):
+        """`eject_after`: consecutive replica-health failures before a
+        replica's circuit opens and its keys re-own to the survivors
+        (0 disables ejection).  `readmit_after_s`: how long an open
+        circuit waits before the replica re-enters the candidate set
+        as a half-open probe.  `failure_policy`: what a descriptor
+        gets when NO replica could answer for it — "open" admits
+        (plain OK, envoy's failure_mode allow default), "closed"
+        denies (OVER_LIMIT).  `transport_ceiling_s`: the transports'
+        own timeout ceiling (proxy --max-subcall-seconds) — used to
+        classify DEADLINE_EXCEEDED as hang vs tight-caller-budget."""
         if len(replica_ids) != len(transports):
             raise ValueError("replica_ids and transports length mismatch")
         if not replica_ids:
             raise ValueError("need at least one replica")
         if len(set(replica_ids)) != len(replica_ids):
             raise ValueError("replica ids must be unique")
+        if failure_policy not in ("open", "closed"):
+            raise ValueError(
+                f"failure_policy must be 'open' or 'closed': {failure_policy!r}"
+            )
         self.replica_ids = list(replica_ids)
         self.transports = list(transports)
+        self.eject_after = int(eject_after)
+        self.readmit_after_s = float(readmit_after_s)
+        self.failure_policy = failure_policy
+        self.transport_ceiling_s = float(transport_ceiling_s)
+        self._circuits = [_Circuit() for _ in replica_ids]
+        self._health_lock = threading.Lock()
         self._pool = ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="replica-router"
         )
@@ -129,6 +240,199 @@ class ReplicaRouter:
 
     def owner_for(self, domain: str, descriptor) -> int:
         return owner_of(routing_key(domain, descriptor), self.replica_ids)
+
+    # -- replica health (sentinel-failover analog) -----------------------
+
+    def live_replica_count(self) -> int:
+        """Replicas whose circuit is not open (the proxy's health
+        surface: all-open -> NOT_SERVING)."""
+        with self._health_lock:
+            return sum(1 for c in self._circuits if not c.is_open)
+
+    def any_live(self) -> bool:
+        return self.live_replica_count() > 0
+
+    # How long one request may hold a half-open probe claim: matches
+    # the transport's no-deadline backstop, so a probe hung on a
+    # blackholed replica cannot block the next probe forever.
+    _PROBE_CLAIM_S = 30.0
+
+    def _candidates_claiming(self) -> tuple:
+        """(candidate indices, claimed-probe indices): circuit closed,
+        or open with the half-open probe due.  The probe is
+        single-flight: the first caller to see it due CLAIMS it
+        (probe_until), and while the claim is held concurrent requests
+        route the replica's key partition to the survivors instead of
+        piling multi-second stalls onto a possibly-still-dead node.  A
+        claim is released (a) by the probe call itself succeeding or
+        failing, (b) by the claiming request when it turns out to own
+        none of the replica's keys, or (c) when the claiming call
+        aborts before reaching the replica (caller-deadline expiry) —
+        so neither skewed traffic nor tight deadlines can starve
+        recovery.  NOTE: claiming MUTATES circuit state; this is not
+        an inspection helper."""
+        now = time.monotonic()
+        out: List[int] = []
+        claimed: List[int] = []
+        with self._health_lock:
+            for i, c in enumerate(self._circuits):
+                if not c.is_open:
+                    out.append(i)
+                elif now >= c.retry_at and now >= c.probe_until:
+                    c.probe_until = now + self._PROBE_CLAIM_S
+                    out.append(i)
+                    claimed.append(i)
+        return out, claimed
+
+    def _release_probes(self, idxs) -> None:
+        if not idxs:
+            return
+        with self._health_lock:
+            for i in idxs:
+                self._circuits[i].probe_until = 0.0
+
+    def _record_failure(self, idx: int, exc: BaseException) -> None:
+        with self._health_lock:
+            c = self._circuits[idx]
+            c.failures += 1
+            newly_open = (
+                self.eject_after > 0
+                and c.failures >= self.eject_after
+                and not c.is_open
+            )
+            if newly_open:
+                c.is_open = True
+            c.probe_until = 0.0  # the probe call itself just finished
+            if c.is_open:
+                # Each failure (first ejection or a failed half-open
+                # probe) re-arms the probation timer.
+                c.retry_at = time.monotonic() + self.readmit_after_s
+        if newly_open:
+            logger.error(
+                "replica %s ejected after %d consecutive failures "
+                "(last: %r); its keys re-own to the survivors",
+                self.replica_ids[idx],
+                self._circuits[idx].failures,
+                exc,
+            )
+
+    def _record_success(self, idx: int) -> None:
+        with self._health_lock:
+            c = self._circuits[idx]
+            was_open = c.is_open
+            c.failures = 0
+            c.is_open = False
+            c.probe_until = 0.0
+        if was_open:
+            logger.warning(
+                "replica %s recovered; re-admitted to the rendezvous set",
+                self.replica_ids[idx],
+            )
+
+    def _checked_call(self, idx: int, sub_request, remaining):
+        """One transport call with circuit bookkeeping.  Replica-health
+        errors raise _ReplicaCallError (drives failover); application
+        statuses and caller-deadline expiry propagate unchanged.
+        Every exit releases any probe claim on `idx` (success/failure
+        release via the recorders; the propagate paths release
+        explicitly) so an aborted probe can't block readmission."""
+        try:
+            budget = remaining()
+        except DeadlineExceededError:
+            self._release_probes([idx])
+            raise
+        # The timeout that can actually expire is the SMALLER of the
+        # caller's budget and the transport ceiling — hang
+        # classification must use it, or a low ceiling would let slow
+        # responses eject healthy replicas.
+        effective = (
+            self.transport_ceiling_s
+            if budget is None
+            else min(budget, self.transport_ceiling_s)
+        )
+        try:
+            resp = self.transports[idx](sub_request, timeout_s=budget)
+        except DeadlineExceededError:
+            self._release_probes([idx])
+            raise
+        except Exception as e:
+            # Exception, not BaseException: KeyboardInterrupt /
+            # SystemExit must propagate, never masquerade as a dead
+            # replica.
+            if not _is_replica_failure(e, effective):
+                self._release_probes([idx])
+                raise
+            self._record_failure(idx, e)
+            raise _ReplicaCallError(idx, self.replica_ids[idx], e) from e
+        self._record_success(idx)
+        return resp
+
+    def _sub_request(self, request, rows: List[int]):
+        sub = rls_pb2.RateLimitRequest(
+            domain=request.domain, hits_addend=request.hits_addend
+        )
+        for i in rows:
+            sub.descriptors.add().CopyFrom(request.descriptors[i])
+        return sub
+
+    def _route_and_call(
+        self, request, rows, cand: List[int], claimed, remaining
+    ):
+        """Group descriptor indices `rows` by rendezvous owner over the
+        candidate set, release probe claims this request routes nothing
+        to, and fan the sub-calls out (first owner inline on the
+        request thread — it would otherwise just block in result() —
+        the rest on the pool).  Returns [(rows, resp|None, err|None)].
+        Shared by the primary fan-out and the failover retry so the
+        claim-release bookkeeping cannot diverge between them."""
+        n = len(request.descriptors)
+        cand_ids = [self.replica_ids[i] for i in cand]
+        by_owner: Dict[int, List[int]] = {}
+        for i in rows:
+            owner = cand[
+                owner_of(
+                    routing_key(request.domain, request.descriptors[i]),
+                    cand_ids,
+                )
+            ]
+            by_owner.setdefault(owner, []).append(i)
+        # A claimed probe this request routes nothing to would starve
+        # recovery if we kept holding it.
+        self._release_probes([i for i in claimed if i not in by_owner])
+
+        def sub_call(owner: int, sub_rows: List[int]):
+            sub = (
+                request
+                if len(sub_rows) == n
+                else self._sub_request(request, sub_rows)
+            )
+            try:
+                return (
+                    sub_rows,
+                    self._checked_call(owner, sub, remaining),
+                    None,
+                )
+            except _ReplicaCallError as e:
+                return sub_rows, None, e
+
+        owners = list(by_owner.items())
+        futures = [
+            self._pool.submit(sub_call, owner, sub_rows)
+            for owner, sub_rows in owners[1:]
+        ]
+        results = [sub_call(*owners[0])]
+        results.extend(f.result() for f in futures)
+        return results
+
+    def _fallback_response(self, n: int) -> rls_pb2.RateLimitResponse:
+        """Every-replica-unreachable answer per the failure policy."""
+        OVER = rls_pb2.RateLimitResponse.OVER_LIMIT
+        OK = rls_pb2.RateLimitResponse.OK
+        code = OK if self.failure_policy == "open" else OVER
+        out = rls_pb2.RateLimitResponse(overall_code=code if n else OK)
+        for _ in range(n):
+            out.statuses.add().code = code
+        return out
 
     def should_rate_limit(
         self,
@@ -150,38 +454,66 @@ class ReplicaRouter:
             return left
 
         n = len(request.descriptors)
-        if n == 0:
-            # Single replica answers the empty/error case so the wire
-            # behavior (INVALID_ARGUMENT on empty domain etc.) is the
-            # service's own, not a router invention.
-            return self.transports[0](request, timeout_s=remaining())
-
-        by_owner: Dict[int, List[int]] = {}
-        for i, d in enumerate(request.descriptors):
-            by_owner.setdefault(self.owner_for(request.domain, d), []).append(i)
-
-        if len(by_owner) == 1:
-            owner = next(iter(by_owner))
-            return self.transports[owner](request, timeout_s=remaining())
-
-        def sub_call(owner: int, rows: List[int]):
-            sub = rls_pb2.RateLimitRequest(
-                domain=request.domain, hits_addend=request.hits_addend
+        cand, claimed = self._candidates_claiming()
+        if not cand:
+            # Every circuit open and no probe due: the failure policy
+            # answers (the proxy's health is NOT_SERVING here too).
+            logger.error(
+                "no live replicas (all %d ejected); failure policy %r "
+                "answers", len(self.replica_ids), self.failure_policy,
             )
-            for i in rows:
-                sub.descriptors.add().CopyFrom(request.descriptors[i])
-            return rows, self.transports[owner](sub, timeout_s=remaining())
+            return self._fallback_response(n)
 
-        # One owner's call runs inline on the request thread (which
-        # would otherwise just block in result()); only the rest go to
-        # the pool — halves pool pressure for the common 2-owner split.
-        owners = list(by_owner.items())
-        futures = [
-            self._pool.submit(sub_call, owner, rows)
-            for owner, rows in owners[1:]
-        ]
-        results = [sub_call(*owners[0])]
-        results.extend(f.result() for f in futures)
+        if n == 0:
+            # A replica answers the empty/error case so the wire
+            # behavior (INVALID_ARGUMENT on empty domain etc.) is the
+            # service's own, not a router invention; walk the live set
+            # on replica failure.
+            untouched = set(claimed)
+            try:
+                for idx in cand:
+                    untouched.discard(idx)
+                    try:
+                        return self._checked_call(idx, request, remaining)
+                    except _ReplicaCallError:
+                        continue
+                return self._fallback_response(0)
+            finally:
+                self._release_probes(untouched)
+
+        outcome = self._route_and_call(
+            request, range(n), cand, claimed, remaining
+        )
+
+        # Failover pass (sentinel analog): descriptors whose owner
+        # failed re-own ONCE over the remaining live set (their
+        # windows restart on the new owner — the amnesia envelope);
+        # if that also fails, the failure policy answers for them.
+        failed = [(rows, err) for rows, _resp, err in outcome if err is not None]
+        results = [(rows, resp) for rows, resp, err in outcome if err is None]
+        fallback_rows: List[int] = []
+        if failed:
+            failed_rows = [i for rows, _err in failed for i in rows]
+            failed_idx = {err.index for _rows, err in failed}
+            retry_cand, retry_claimed = self._candidates_claiming()
+            retry_set = [i for i in retry_cand if i not in failed_idx]
+            # Claims on replicas excluded from the retry set (the
+            # just-failed owner) release immediately.
+            self._release_probes(
+                [i for i in retry_claimed if i not in retry_set]
+            )
+            retry_claimed = [i for i in retry_claimed if i in retry_set]
+            if not retry_set:
+                fallback_rows.extend(failed_rows)
+            else:
+                retries = self._route_and_call(
+                    request, failed_rows, retry_set, retry_claimed, remaining
+                )
+                for rows, resp, err in retries:
+                    if err is None:
+                        results.append((rows, resp))
+                    else:
+                        fallback_rows.extend(rows)
 
         # Merge: statuses back to request order; overall code is the
         # logical OR (service/ratelimit.go:185-190); headers follow
@@ -216,6 +548,22 @@ class ReplicaRouter:
                     rank = (sub_min, sub_resp.overall_code != OVER)
                     if best_hdr is None or rank < best_hdr[0]:
                         best_hdr = (rank, sub_resp)
+        if fallback_rows:
+            # Policy answer for descriptors no live replica could
+            # serve: "open" admits them (plain OK, no limit attached —
+            # the same shape as a no-matching-rule descriptor),
+            # "closed" denies them and forces the overall code.
+            code = (
+                rls_pb2.RateLimitResponse.OK
+                if self.failure_policy == "open"
+                else OVER
+            )
+            if code == OVER:
+                out.overall_code = OVER
+            for i in fallback_rows:
+                st = rls_pb2.RateLimitResponse.DescriptorStatus()
+                st.code = code
+                statuses[i] = st
         for s in statuses:
             out.statuses.add().CopyFrom(s)
         if best_hdr is not None:

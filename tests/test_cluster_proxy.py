@@ -201,3 +201,129 @@ def test_proxy_serves_grpc_health(stack):
         )
         resp = check(health_pb2.HealthCheckRequest(), timeout=10)
     assert resp.status == health_pb2.HealthCheckResponse.SERVING
+
+
+def test_proxy_health_reflects_replica_liveness():
+    """grpc.health.v1 on the proxy answers SERVING while any replica
+    circuit is closed and NOT_SERVING once every replica is ejected —
+    the drain signal for a partition-blind proxy (r3 VERDICT weak #5).
+    Wire-level over the proxy's real server; replicas are dead fakes."""
+    from grpchealth.v1 import health_pb2
+
+    from ratelimit_tpu.cluster.proxy import RouterHolder, make_server
+    from ratelimit_tpu.cluster.router import ReplicaRouter
+
+    def dead(req, timeout_s=None):
+        raise ConnectionError("replica down")
+
+    router = ReplicaRouter(
+        ["d0:1", "d1:2"], [dead, dead], eject_after=1,
+        readmit_after_s=60.0,
+    )
+    holder = RouterHolder(router)
+    server, bound = make_server(holder, "127.0.0.1", 0)
+    server.start()
+    try:
+        with grpc.insecure_channel(f"127.0.0.1:{bound}") as ch:
+            check = ch.unary_unary(
+                "/grpc.health.v1.Health/Check",
+                request_serializer=health_pb2.HealthCheckRequest.SerializeToString,
+                response_deserializer=health_pb2.HealthCheckResponse.FromString,
+            )
+            assert (
+                check(health_pb2.HealthCheckRequest(), timeout=10).status
+                == health_pb2.HealthCheckResponse.SERVING
+            )
+            # Kill both circuits through real traffic; the failure
+            # policy (open) still answers the RPC itself.
+            resp = _call(f"127.0.0.1:{bound}", _request("dead"))
+            assert resp.overall_code == rls_pb2.RateLimitResponse.OK
+            assert router.live_replica_count() == 0
+            assert (
+                check(health_pb2.HealthCheckRequest(), timeout=10).status
+                == health_pb2.HealthCheckResponse.NOT_SERVING
+            )
+    finally:
+        server.stop(grace=None)
+        router.close()
+
+
+def test_proxy_subcall_deadline_ceiling_is_configurable():
+    """Sub-call timeouts: a SHORTER caller budget governs; a longer
+    one is bounded by the explicit --max-subcall-seconds ceiling
+    (r3 VERDICT weak #5: the old 30s clamp was silent and fixed;
+    an unbounded deadline would let a blackholed replica pin proxy
+    workers for an arbitrary client-chosen time)."""
+    from ratelimit_tpu.cluster.proxy import grpc_transport
+
+    seen = {}
+
+    class _FakeMethod:
+        def __call__(self, request, timeout=None):
+            seen["timeout"] = timeout
+            return rls_pb2.RateLimitResponse()
+
+    class _FakeChannel:
+        def unary_unary(self, *a, **kw):
+            return _FakeMethod()
+
+    call = grpc_transport(_FakeChannel())
+    call(rls_pb2.RateLimitRequest(), timeout_s=2.0)
+    assert seen["timeout"] == 2.0  # caller budget governs below cap
+    call(rls_pb2.RateLimitRequest(), timeout_s=None)
+    assert seen["timeout"] == 30.0  # backstop when unset
+    call(rls_pb2.RateLimitRequest(), timeout_s=120.0)
+    assert seen["timeout"] == 30.0  # default ceiling bounds it
+
+    raised = grpc_transport(_FakeChannel(), max_subcall_s=300.0)
+    raised(rls_pb2.RateLimitRequest(), timeout_s=120.0)
+    assert seen["timeout"] == 120.0  # operator raised the ceiling
+
+
+def test_watcher_retries_empty_file(tmp_path):
+    """An empty replicas file is bad state: keep old membership AND
+    retry next poll (ADVICE r3: mtime must not be marked consumed)."""
+    import time as _t
+
+    from ratelimit_tpu.cluster.proxy import (
+        RouterHolder,
+        watch_replicas_file,
+    )
+    from ratelimit_tpu.cluster.router import ReplicaRouter
+
+    def fake(req, timeout_s=None):
+        return rls_pb2.RateLimitResponse()
+
+    f = tmp_path / "replicas.txt"
+    f.write_text("a:1\n")
+    holder = RouterHolder(ReplicaRouter(["a:1"], [fake]))
+    built = []
+
+    def build(addrs):
+        built.append(list(addrs))
+        return ReplicaRouter(addrs, [fake] * len(addrs))
+
+    t, stop = watch_replicas_file(holder, str(f), poll_s=0.05, build=build)
+    try:
+        # Same mtime second: force distinct mtimes explicitly.
+        import os
+
+        f.write_text("")  # bad state: empty
+        os.utime(str(f), (1_000_000, 1_000_000))
+        _t.sleep(0.2)
+        assert holder.replica_ids == ["a:1"]  # kept old
+        # Recovery WITHOUT an mtime bump past the bad write would be
+        # missed if the empty read had been marked consumed; the fix
+        # re-reads on every poll until a good read lands.  Write the
+        # good state with the SAME mtime as the bad one.
+        f.write_text("a:1\nb:2\n")
+        os.utime(str(f), (1_000_000, 1_000_000))
+        deadline = _t.monotonic() + 5
+        while holder.replica_ids != ["a:1", "b:2"] and _t.monotonic() < deadline:
+            _t.sleep(0.05)
+        assert holder.replica_ids == ["a:1", "b:2"]
+        assert built and built[-1] == ["a:1", "b:2"]
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        holder.close()
