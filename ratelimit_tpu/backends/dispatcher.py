@@ -130,6 +130,12 @@ class WorkItem:
     # keep the default: their apply still runs on the completer.
     defer_apply: bool = False
     result: Optional[tuple] = None  # (HostDecisions, lo, hi)
+    # Optional per-stage timestamp sink: when set, the pipeline stamps
+    # perf_counter() at "launch" (collector hands the batch to the
+    # device) and "complete" (readback+decide done, waiter signalled).
+    # The submitter owns "submit"/"applied".  Powers the closed-loop
+    # latency harness (benchmarks/closed_loop_p99.py); None in serving.
+    trace: Optional[dict] = None
     event: threading.Event = field(default_factory=threading.Event)
     error: Optional[BaseException] = None
 
@@ -235,12 +241,15 @@ def submit_items(engine, items: List[WorkItem]):
         blobs = []
         metas = []
         now = None
+        traces = []
         for it in items:
             p = it.get_pack()
             blobs.append(p.key_blob)
             metas.append(p.meta_u8)
             if now is None or it.now > now:
                 now = it.now
+            if it.trace is not None:
+                traces.append(it.trace)
         if len(metas) == 1:
             blob, meta = blobs[0], items[0].pack.meta
         elif metas:
@@ -252,7 +261,18 @@ def submit_items(engine, items: List[WorkItem]):
             for it in items:
                 it.event.set()
             return None
-        return engine.submit_packed(now, blob, meta)
+        token = engine.submit_packed(now, blob, meta)
+        if traces:
+            # Stamped AFTER submit_packed returns: "launch" means the
+            # device step is in flight — host-side assign/dedup/
+            # transfer cost lands in intake->launch, so the
+            # launch->complete stage is purely the device leg +
+            # readback + decide (the part that moves to the chip on
+            # real hardware).
+            t_launch = time.perf_counter()
+            for tr in traces:
+                tr["launch"] = t_launch
+        return token
     except BaseException as e:
         for it in items:
             it.fail(e)
@@ -277,6 +297,7 @@ def complete_items(engine, items: List[WorkItem], token) -> bool:
             it.fail(e)
         return False
     off = 0
+    t_complete = None
     for it in items:
         n = it.n_lanes
         end = off + n
@@ -291,6 +312,10 @@ def complete_items(engine, items: List[WorkItem], token) -> bool:
             except BaseException as e:
                 it.error = e
         off = end
+        if it.trace is not None:
+            if t_complete is None:
+                t_complete = time.perf_counter()
+            it.trace["complete"] = t_complete
         it.event.set()
     return True
 
