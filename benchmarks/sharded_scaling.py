@@ -66,6 +66,7 @@ def main() -> None:
     for nd in (1, 2, 4, 8):
         engine = ShardedCounterEngine(make_mesh(nd), num_slots=NUM_SLOTS)
         widths = []
+        bank_counts = []
         # warm
         engine.step(batches[0])
         engine.reset()
@@ -75,6 +76,7 @@ def main() -> None:
             # token = (hits, limits, shadow, chunks); chunks[0][0] is
             # the routed (num_banks, cap) device afters handle.
             widths.append(token[3][0][0].shape[1])  # routed cap
+            bank_counts.append(engine.stat_bank_lane_counts)
             d = engine.step_complete(token)
             np.testing.assert_array_equal(
                 d.codes, ref_decisions[i].codes, err_msg=f"mesh {nd}"
@@ -86,10 +88,23 @@ def main() -> None:
         np.testing.assert_array_equal(
             engine.export_counts(), ref.export_counts()
         )
+        # Per-bank REAL lane counts (not the padded cap): the scaling
+        # evidence the r3 verdict asked for — each bank's share must
+        # shrink ~1/n and stay balanced (modulo striping).
+        bc = np.asarray(bank_counts)  # (steps, nd)
         rows.append(
             {
                 "banks": nd,
                 "per_chip_lanes": int(np.mean(widths)),
+                "per_bank_real_lanes_mean": [
+                    round(float(x), 1) for x in bc.mean(axis=0)
+                ],
+                "per_bank_real_lanes_max": [
+                    int(x) for x in bc.max(axis=0)
+                ],
+                "bank_imbalance_max_over_mean": round(
+                    float(bc.max() / max(bc.mean(), 1e-9)), 3
+                ),
                 "full_batch": BATCH,
                 "work_fraction": round(float(np.mean(widths)) / BATCH, 3),
                 "virtual_mesh_ms_per_step": round(elapsed / STEPS * 1e3, 2),

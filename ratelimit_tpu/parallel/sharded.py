@@ -354,6 +354,11 @@ class ShardedCounterEngine(CounterEngine):
         starts = np.concatenate([[0], np.cumsum(counts_pb)])
         pos = np.arange(len(vi)) - starts[banks]
         cap = self._bucket(max(int(counts_pb.max(initial=1)), 1))
+        # Routed-balance gauge: real lanes each bank received in the
+        # last chunk (scaling evidence + live balance observation;
+        # initialized in __init__ so stats scrapes before the first
+        # step never AttributeError).
+        self.stat_bank_lane_counts = counts_pb.tolist()
 
         # ONE packed int32[nb, 4, cap] routed transfer (vs five routed
         # arrays; see CounterEngine._device_submit).  Padding slots are
@@ -408,6 +413,7 @@ class ShardedCounterEngine(CounterEngine):
             buckets=buckets,
             model=ShardedFixedWindowModel(num_slots, mesh, near_ratio),
         )
+        self.stat_bank_lane_counts = [0] * self.model.num_banks
 
     def export_counts(self) -> np.ndarray:
         """Flat uint32 copy in GLOBAL slot order: bank b's local
