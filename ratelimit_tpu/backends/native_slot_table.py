@@ -66,43 +66,46 @@ def _build() -> bool:
 
 
 def _signatures(lib: ctypes.CDLL) -> None:
-    i64, u8p, i64p = ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64)
-    lib.sk_create.restype = ctypes.c_void_p
+    # All pointer parameters are declared c_void_p and passed as RAW
+    # ADDRESS INTS (arr.ctypes.data): building a typed POINTER object
+    # per argument (data_as) costs ~2.6us each, and the hot calls take
+    # 10-27 pointers — at small serving batches that marshaling was
+    # ~40% of the whole native call (profile, round 4).  The C side is
+    # unchanged; int addresses are valid c_void_p values.  Every array
+    # passed is a live local of the calling function, so the missing
+    # keep-alive reference data_as provided is not needed.
+    i64, vp = ctypes.c_int64, ctypes.c_void_p
+    lib.sk_create.restype = vp
     lib.sk_create.argtypes = [i64]
-    lib.sk_destroy.argtypes = [ctypes.c_void_p]
+    lib.sk_destroy.argtypes = [vp]
     lib.sk_len.restype = i64
-    lib.sk_len.argtypes = [ctypes.c_void_p]
+    lib.sk_len.argtypes = [vp]
     lib.sk_evictions.restype = i64
-    lib.sk_evictions.argtypes = [ctypes.c_void_p]
+    lib.sk_evictions.argtypes = [vp]
     lib.sk_arena_bytes.restype = i64
-    lib.sk_arena_bytes.argtypes = [ctypes.c_void_p]
+    lib.sk_arena_bytes.argtypes = [vp]
     lib.sk_gc.restype = i64
-    lib.sk_gc.argtypes = [ctypes.c_void_p, i64]
-    lib.sk_begin_batch.argtypes = [ctypes.c_void_p]
-    lib.sk_end_batch.argtypes = [ctypes.c_void_p]
+    lib.sk_gc.argtypes = [vp, i64]
+    lib.sk_begin_batch.argtypes = [vp]
+    lib.sk_end_batch.argtypes = [vp]
     lib.sk_assign_batch.restype = i64
-    lib.sk_assign_batch.argtypes = [
-        ctypes.c_void_p, u8p, i64p, i64, i64, i64p, i64p, u8p,
-    ]
-    u32p = ctypes.POINTER(ctypes.c_uint32)
-    i32p = ctypes.POINTER(ctypes.c_int32)
-    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.sk_assign_batch.argtypes = [vp, vp, vp, i64, i64, vp, vp, vp]
     lib.sk_assign_dedup_batch.restype = i64
     lib.sk_assign_dedup_batch.argtypes = [
-        ctypes.c_void_p, u8p, i64p, i64, i64, i64p, u32p, u32p,
-        i32p, i32p, u64p, u64p, u8p, u32p,
+        vp, vp, vp, i64, i64, vp, vp, vp,
+        vp, vp, vp, vp, vp, vp,
     ]
     lib.sk_export_size.restype = i64
-    lib.sk_export_size.argtypes = [ctypes.c_void_p, i64p]
-    lib.sk_export.argtypes = [ctypes.c_void_p, u8p, i64p, i64p, i64p]
+    lib.sk_export_size.argtypes = [vp, vp]
+    lib.sk_export.argtypes = [vp, vp, vp, vp, vp]
     lib.sk_import.restype = i64
-    lib.sk_import.argtypes = [ctypes.c_void_p, u8p, i64p, i64p, i64p, i64]
+    lib.sk_import.argtypes = [vp, vp, vp, vp, vp, i64]
     lib.sk_decide_reconstruct.restype = None
     lib.sk_decide_reconstruct.argtypes = [
-        u32p, u64p, i64,  # afters_g, totals, g
-        i32p, u64p, u32p, u32p, u8p, i64,  # inv, prefix, hits, limits, shadow, n
+        vp, vp, i64,  # afters_g, totals, g
+        vp, vp, vp, vp, vp, i64,  # inv, prefix, hits, limits, shadow, n
         ctypes.c_float, ctypes.c_int32, ctypes.c_int32,  # ratio, codes
-        i32p, i64p, i64p, i64p, i64p, i64p, i64p, i64p, u8p,  # outputs
+        vp, vp, vp, vp, vp, vp, vp, vp, vp,  # outputs
     ]
 
 
@@ -152,12 +155,9 @@ def _pack_keys(keys: List[str]) -> Tuple[np.ndarray, np.ndarray]:
     return blob, lens
 
 
-def _i64p(a: np.ndarray):
-    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
-
-
-def _u8p(a: np.ndarray):
-    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+def _ptr(a: np.ndarray) -> int:
+    """Raw data address for a c_void_p parameter (see _signatures)."""
+    return a.ctypes.data
 
 
 def decide_reconstruct(
@@ -192,49 +192,45 @@ def decide_reconstruct(
     limits = np.ascontiguousarray(limits, dtype=np.uint32)
     shadow = np.ascontiguousarray(shadow, dtype=np.uint8)
     out_codes = np.empty(n, dtype=np.int32)
-    out_remaining = np.empty(n, dtype=np.int64)
-    out_befores = np.empty(n, dtype=np.int64)
-    out_afters = np.empty(n, dtype=np.int64)
-    out_over = np.empty(n, dtype=np.int64)
-    out_near = np.empty(n, dtype=np.int64)
-    out_within = np.empty(n, dtype=np.int64)
-    out_shadow = np.empty(n, dtype=np.int64)
+    # The seven int64 outputs share ONE allocation; the C side's
+    # per-field pointers are row offsets into it (7 fewer argument
+    # marshals and allocations per call — small-batch latency).
+    out_i64 = np.empty((7, n), dtype=np.int64)
     out_set_lc = np.empty(n, dtype=np.bool_)
-    u32p = ctypes.POINTER(ctypes.c_uint32)
-    i32p = ctypes.POINTER(ctypes.c_int32)
-    u64p = ctypes.POINTER(ctypes.c_uint64)
+    base = out_i64.ctypes.data
+    row = n * 8
     lib.sk_decide_reconstruct(
-        afters_g.ctypes.data_as(u32p),
-        totals.ctypes.data_as(u64p),
+        _ptr(afters_g),
+        _ptr(totals),
         g,
-        inv.ctypes.data_as(i32p),
-        prefix.ctypes.data_as(u64p),
-        hits.ctypes.data_as(u32p),
-        limits.ctypes.data_as(u32p),
-        _u8p(shadow),
+        _ptr(inv),
+        _ptr(prefix),
+        _ptr(hits),
+        _ptr(limits),
+        _ptr(shadow),
         n,
         ctypes.c_float(near_ratio),
         int(ok_code),
         int(over_code),
-        out_codes.ctypes.data_as(i32p),
-        _i64p(out_remaining),
-        _i64p(out_befores),
-        _i64p(out_afters),
-        _i64p(out_over),
-        _i64p(out_near),
-        _i64p(out_within),
-        _i64p(out_shadow),
-        _u8p(out_set_lc),
+        _ptr(out_codes),
+        base,  # remaining
+        base + row,  # befores
+        base + 2 * row,  # afters
+        base + 3 * row,  # over
+        base + 4 * row,  # near
+        base + 5 * row,  # within
+        base + 6 * row,  # shadow
+        _ptr(out_set_lc),
     )
     return (
         out_codes,
-        out_remaining,
-        out_befores,
-        out_afters,
-        out_over,
-        out_near,
-        out_within,
-        out_shadow,
+        out_i64[0],
+        out_i64[1],
+        out_i64[2],
+        out_i64[3],
+        out_i64[4],
+        out_i64[5],
+        out_i64[6],
         out_set_lc,
     )
 
@@ -292,13 +288,13 @@ class NativeSlotTable:
         out_fresh = np.empty(n, dtype=np.uint8)
         rc = self._lib.sk_assign_batch(
             self._handle,
-            _u8p(blob),
-            _i64p(lens),
+            _ptr(blob),
+            _ptr(lens),
             n,
             int(now),
-            _i64p(exp),
-            _i64p(out_slots),
-            _u8p(out_fresh),
+            _ptr(exp),
+            _ptr(out_slots),
+            _ptr(out_fresh),
         )
         if rc != 0:
             raise RuntimeError(
@@ -351,24 +347,21 @@ class NativeSlotTable:
         out_prefix = np.empty(n, dtype=np.uint64)
         out_freshg = np.empty(n, dtype=np.uint8)
         out_limitmax = np.empty(n, dtype=np.uint32)
-        u32p = ctypes.POINTER(ctypes.c_uint32)
-        i32p = ctypes.POINTER(ctypes.c_int32)
-        u64p = ctypes.POINTER(ctypes.c_uint64)
         g = self._lib.sk_assign_dedup_batch(
             self._handle,
-            _u8p(key_blob),
-            _i64p(key_lens),
+            _ptr(key_blob),
+            _ptr(key_lens),
             n,
             int(now),
-            _i64p(expiries),
-            hits.ctypes.data_as(u32p),
-            limits.ctypes.data_as(u32p),
-            out_group.ctypes.data_as(i32p),
-            out_uniq.ctypes.data_as(i32p),
-            out_totals.ctypes.data_as(u64p),
-            out_prefix.ctypes.data_as(u64p),
-            out_freshg.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-            out_limitmax.ctypes.data_as(u32p),
+            _ptr(expiries),
+            _ptr(hits),
+            _ptr(limits),
+            _ptr(out_group),
+            _ptr(out_uniq),
+            _ptr(out_totals),
+            _ptr(out_prefix),
+            _ptr(out_freshg),
+            _ptr(out_limitmax),
         )
         if g < 0:
             raise RuntimeError(
@@ -398,7 +391,7 @@ class NativeSlotTable:
         slots = np.empty(n, dtype=np.int64)
         expiries = np.empty(n, dtype=np.int64)
         self._lib.sk_export(
-            self._handle, _u8p(blob), _i64p(lens), _i64p(slots), _i64p(expiries)
+            self._handle, _ptr(blob), _ptr(lens), _ptr(slots), _ptr(expiries)
         )
         out = []
         raw = blob.tobytes()
@@ -420,6 +413,6 @@ class NativeSlotTable:
             slots = np.asarray([e[1] for e in entries], dtype=np.int64)
             exp = np.asarray([e[2] for e in entries], dtype=np.int64)
             t._lib.sk_import(
-                t._handle, _u8p(blob), _i64p(lens), _i64p(slots), _i64p(exp), len(keys)
+                t._handle, _ptr(blob), _ptr(lens), _ptr(slots), _ptr(exp), len(keys)
             )
         return t
