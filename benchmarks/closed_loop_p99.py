@@ -40,6 +40,14 @@ import numpy as np  # noqa: E402
 
 WINDOW_US = 200
 DESCRIPTORS = 4
+BENCH_YAML = (
+    "domain: bench\n"
+    "descriptors:\n"
+    "  - key: k\n"
+    "    rate_limit:\n"
+    "      unit: hour\n"
+    "      requests_per_unit: 1000000\n"
+)
 REQUESTS_PER_WORKER = 600
 CONCURRENCIES = (1, 2, 4, 8)
 
@@ -63,15 +71,7 @@ def build_config():
     from ratelimit_tpu.config.loader import ConfigFile, load_config
     from ratelimit_tpu.stats.manager import Manager
 
-    yaml_text = (
-        "domain: bench\n"
-        "descriptors:\n"
-        "  - key: k\n"
-        "    rate_limit:\n"
-        "      unit: hour\n"
-        "      requests_per_unit: 1000000\n"
-    )
-    return load_config([ConfigFile("config.bench", yaml_text)], Manager())
+    return load_config([ConfigFile("config.bench", BENCH_YAML)], Manager())
 
 
 def closed_loop(cache, cfg, workers: int):
@@ -218,6 +218,128 @@ def staged_closed_loop(cache, workers: int = 4, n_traced: int = 400):
     }
 
 
+def wire_closed_loop(workers: int, requests_per_worker: int = 400):
+    """The SAME closed loop through a real Runner's gRPC server — the
+    BASELINE metric's actual surface (p99 ShouldRateLimit).  Adds
+    grpcio client+server overhead on the same single core."""
+    import tempfile
+
+    import grpc
+
+    from ratelimit_tpu.runner import Runner
+    from ratelimit_tpu.settings import Settings
+    from ratelimit_tpu.utils.time import PinnedTimeSource
+
+    from ratelimit_tpu.server import pb  # noqa: F401
+    from envoy.service.ratelimit.v3 import rls_pb2
+
+    tmp = tempfile.TemporaryDirectory()
+    root = tmp.name
+    os.makedirs(os.path.join(root, "rl", "config"))
+    with open(os.path.join(root, "rl", "config", "c.yaml"), "w") as f:
+        f.write(BENCH_YAML)
+    r = Runner(
+        Settings(
+            host="127.0.0.1", port=0, grpc_host="127.0.0.1", grpc_port=0,
+            debug_host="127.0.0.1", debug_port=0, use_statsd=False,
+            backend_type="tpu", tpu_num_slots=1 << 16,
+            tpu_batch_window_us=WINDOW_US, tpu_batch_limit=1024,
+            tpu_batch_buckets=[8, 32, 128, 1024],
+            runtime_path=root, runtime_subdirectory="rl",
+            local_cache_size_in_bytes=0, expiration_jitter_max_seconds=0,
+            tpu_warmup=True,
+        ),
+        time_source=PinnedTimeSource(1_000_000),
+    )
+    r.start()
+    try:
+        return _wire_drive(r, workers, requests_per_worker)
+    finally:
+        r.stop()
+        tmp.cleanup()
+
+
+def _wire_drive(r, workers: int, requests_per_worker: int):
+    import grpc
+
+    from ratelimit_tpu.server import pb  # noqa: F401
+    from envoy.service.ratelimit.v3 import rls_pb2
+
+    addr = f"127.0.0.1:{r.grpc_server.bound_port}"
+
+    # Wire-overhead control: the no-op health RPC through the SAME
+    # server measures what grpcio client+server alone cost on this
+    # core — serving latency on the wire is rpc_floor + the in-process
+    # numbers, and only the delta is this framework's.
+    from grpchealth.v1 import health_pb2
+
+    floor = []
+    with grpc.insecure_channel(addr) as ch:
+        check = ch.unary_unary(
+            "/grpc.health.v1.Health/Check",
+            request_serializer=health_pb2.HealthCheckRequest.SerializeToString,
+            response_deserializer=health_pb2.HealthCheckResponse.FromString,
+        )
+        check(health_pb2.HealthCheckRequest(), timeout=30)
+        for _ in range(300):
+            t0 = time.perf_counter()
+            check(health_pb2.HealthCheckRequest(), timeout=30)
+            floor.append(time.perf_counter() - t0)
+
+    lat = [[] for _ in range(workers)]
+    errors = []
+    gate = threading.Event()
+
+    def worker(w):
+        with grpc.insecure_channel(addr) as channel:
+            method = channel.unary_unary(
+                "/envoy.service.ratelimit.v3.RateLimitService/"
+                "ShouldRateLimit",
+                request_serializer=(
+                    rls_pb2.RateLimitRequest.SerializeToString
+                ),
+                response_deserializer=rls_pb2.RateLimitResponse.FromString,
+            )
+            reqs = []
+            for i in range(requests_per_worker):
+                q = rls_pb2.RateLimitRequest(domain="bench", hits_addend=1)
+                for j in range(DESCRIPTORS):
+                    d = q.descriptors.add()
+                    e = d.entries.add()
+                    e.key, e.value = "k", f"w{w}r{i}d{j}"
+                reqs.append(q)
+            method(reqs[0], timeout=60)  # connection + shape warm
+            gate.wait()
+            try:
+                for q in reqs:
+                    t0 = time.perf_counter()
+                    method(q, timeout=60)
+                    lat[w].append(time.perf_counter() - t0)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+    threads = [
+        threading.Thread(target=worker, args=(w,)) for w in range(workers)
+    ]
+    for t in threads:
+        t.start()
+    gate.set()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    flat = [x for per in lat for x in per]
+    return {
+        "concurrency": workers,
+        "requests": len(flat),
+        "p50_ms": pct(flat, 50),
+        "p99_ms": pct(flat, 99),
+        "max_ms": pct(flat, 100),
+        "grpc_noop_floor_p50_ms": pct(floor, 50),
+        "grpc_noop_floor_p99_ms": pct(floor, 99),
+    }
+
+
 def main():
     import jax
 
@@ -260,6 +382,16 @@ def main():
     finally:
         cache.close()
 
+    wire_rows = []
+    wire_error = None
+    try:
+        for c in (1, 2, 4):
+            wire_rows.append(wire_closed_loop(c))
+            print("wire", wire_rows[-1])
+    except Exception as e:  # keep the in-process rows; record the gap
+        wire_error = repr(e)
+        print("wire measurement failed:", wire_error)
+
     out = {
         "device": str(dev),
         "config": {
@@ -272,6 +404,14 @@ def main():
             "disabled",
         },
         "closed_loop": rows,
+        "wire_closed_loop": {
+            "description": "the same closed loop through a real "
+            "Runner's gRPC server (the BASELINE metric's surface: "
+            "p99 ShouldRateLimit) — adds grpcio client+server "
+            "overhead on the same single core",
+            "rows": wire_rows,
+            **({"error": wire_error} if wire_error else {}),
+        },
         "event_wait_control": {
             "description": "wakeup overshoot of event.wait(200us) with "
             "no serving work — the floor the scheduler imposes on the "
