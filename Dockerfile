@@ -13,7 +13,7 @@ RUN apt-get update && apt-get install -y --no-install-recommends g++ \
 WORKDIR /build
 COPY native/ native/
 RUN g++ -O2 -std=c++20 -shared -fPIC -o _libslottable.so \
-    native/slot_table.cpp
+    native/slot_table.cpp native/decide.cpp
 
 FROM python:3.12-slim
 
