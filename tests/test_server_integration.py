@@ -521,3 +521,45 @@ def test_per_second_bank_wired_through_runner(tmp_path_factory):
         assert "ratelimit.tpu.bank1.live_keys: 1" in text
     finally:
         r.stop()
+
+
+def test_window_rollover_and_decay_over_the_wire(runner):
+    """The reference's DurationUntilReset-decay and window-rollover
+    integration assertions (integration_test.go:436-496,585-596),
+    previously untestable at the wire level without flakes — the
+    Runner's injected PinnedTimeSource makes them deterministic:
+    duration decays as the clock advances, and crossing the minute
+    boundary grants a fresh quota for the same key."""
+    clock = runner.time_source
+    start = clock.now
+    # Derived from whatever the fixture pinned (epoch-independent);
+    # the fixture guarantees a mid-window start.
+    to_boundary = 60 - start % 60
+    assert 7 < to_boundary < 60
+    try:
+        OK = rls_pb2.RateLimitResponse.OK
+        OVER = rls_pb2.RateLimitResponse.OVER_LIMIT
+        req = _request("basic", [("key1", "rollover")])
+
+        # Exhaust the 5/min quota; duration reflects the pinned offset.
+        codes = [
+            _grpc_call(runner, req).overall_code for _ in range(6)
+        ]
+        assert codes == [OK] * 5 + [OVER]
+        st = _grpc_call(runner, req).statuses[0]
+        assert st.duration_until_reset.seconds == to_boundary
+
+        # Decay: +7s inside the same window — still OVER.
+        clock.advance(7)
+        st = _grpc_call(runner, req).statuses[0]
+        assert st.code == OVER
+        assert st.duration_until_reset.seconds == to_boundary - 7
+
+        # Rollover: cross the boundary — fresh quota for the SAME key.
+        clock.advance(to_boundary - 7)
+        resp = _grpc_call(runner, req)
+        assert resp.overall_code == OK
+        assert resp.statuses[0].limit_remaining == 4
+        assert resp.statuses[0].duration_until_reset.seconds == 60
+    finally:
+        clock.now = start  # don't leak time travel into other tests
