@@ -10,7 +10,6 @@ cluster tests against local redis processes).
 
 import importlib.util
 import os
-import sys
 
 _SPEC = importlib.util.spec_from_file_location(
     "graft_entry_under_test",

@@ -12,7 +12,7 @@ import numpy as np
 import pytest
 
 from ratelimit_tpu.backends import native_slot_table
-from ratelimit_tpu.backends.engine import _Dedup, _decide_host, _dedup_chunk
+from ratelimit_tpu.backends.engine import _decide_host, _dedup_chunk
 
 pytestmark = pytest.mark.skipif(
     not native_slot_table.available(), reason="native library unavailable"
