@@ -109,6 +109,9 @@ class RouterHolder:
         surface a load balancer drains a partition-blind proxy on."""
         return self._router.live_replica_count() > 0
 
+    def stats(self) -> dict:
+        return self._router.stats()
+
     def should_rate_limit(self, request, timeout_s=None):
         return self._router.should_rate_limit(request, timeout_s=timeout_s)
 
@@ -333,6 +336,20 @@ def main(argv=None) -> None:
         "cluster proxy serving :%d over %d replicas", bound, len(addrs)
     )
     stop = threading.Event()
+
+    def stats_logger() -> None:
+        # Periodic failover-counter line (the redis pool-gauge analog)
+        # — only when something changed since the last line.
+        last = None
+        while not stop.wait(60.0):
+            snap = holder.stats()
+            if snap != last:
+                logger.warning("cluster stats: %s", snap)
+                last = snap
+
+    threading.Thread(
+        target=stats_logger, name="proxy-stats", daemon=True
+    ).start()
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: stop.set())
     stop.wait()

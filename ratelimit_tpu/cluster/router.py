@@ -231,9 +231,28 @@ class ReplicaRouter:
         self.transport_ceiling_s = float(transport_ceiling_s)
         self._circuits = [_Circuit() for _ in replica_ids]
         self._health_lock = threading.Lock()
+        # Failover observability (the redis pool-gauge analog,
+        # driver_impl.go:17-29): plain ints, ALWAYS mutated under
+        # _health_lock (bare += from concurrent request threads can
+        # lose increments); read lock-free by stats()/log lines.
+        self.stat_ejections = 0
+        self.stat_readmissions = 0
+        self.stat_failovers = 0  # sub-requests re-routed to a survivor
+        self.stat_fallback_descriptors = 0  # answered by failure policy
         self._pool = ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="replica-router"
         )
+
+    def stats(self) -> dict:
+        """Snapshot of the failover counters + live membership."""
+        return {
+            "replicas": len(self.replica_ids),
+            "live_replicas": self.live_replica_count(),
+            "ejections": self.stat_ejections,
+            "readmissions": self.stat_readmissions,
+            "failovers": self.stat_failovers,
+            "fallback_descriptors": self.stat_fallback_descriptors,
+        }
 
     def close(self) -> None:
         self._pool.shutdown(wait=False)
@@ -302,6 +321,7 @@ class ReplicaRouter:
             )
             if newly_open:
                 c.is_open = True
+                self.stat_ejections += 1
             c.probe_until = 0.0  # the probe call itself just finished
             if c.is_open:
                 # Each failure (first ejection or a failed half-open
@@ -323,6 +343,8 @@ class ReplicaRouter:
             c.failures = 0
             c.is_open = False
             c.probe_until = 0.0
+            if was_open:
+                self.stat_readmissions += 1
         if was_open:
             logger.warning(
                 "replica %s recovered; re-admitted to the rendezvous set",
@@ -426,6 +448,8 @@ class ReplicaRouter:
 
     def _fallback_response(self, n: int) -> rls_pb2.RateLimitResponse:
         """Every-replica-unreachable answer per the failure policy."""
+        with self._health_lock:
+            self.stat_fallback_descriptors += n
         OVER = rls_pb2.RateLimitResponse.OVER_LIMIT
         OK = rls_pb2.RateLimitResponse.OK
         code = OK if self.failure_policy == "open" else OVER
@@ -509,11 +533,19 @@ class ReplicaRouter:
                 retries = self._route_and_call(
                     request, failed_rows, retry_set, retry_claimed, remaining
                 )
+                ok_retries = 0
                 for rows, resp, err in retries:
                     if err is None:
+                        ok_retries += 1
                         results.append((rows, resp))
                     else:
                         fallback_rows.extend(rows)
+                if ok_retries:
+                    with self._health_lock:
+                        self.stat_failovers += ok_retries
+            if fallback_rows:
+                with self._health_lock:
+                    self.stat_fallback_descriptors += len(fallback_rows)
 
         # Merge: statuses back to request order; overall code is the
         # logical OR (service/ratelimit.go:185-190); headers follow

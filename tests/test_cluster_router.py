@@ -368,6 +368,12 @@ def test_dead_replica_fails_over_and_ejects():
         for q in reqs:
             r.should_rate_limit(q)
         assert fakes[1].calls == 0
+        # Observability: ejection + in-request failovers counted.
+        st = r.stats()
+        assert st["ejections"] == 1
+        assert st["live_replicas"] == 2
+        assert st["failovers"] > 0
+        assert st["fallback_descriptors"] == 0
         # Survivors carried the dead replica's keys (every request
         # answered above), and carried them CONSISTENTLY: the same
         # request re-owns to the same survivor.
@@ -398,6 +404,7 @@ def test_ejected_replica_readmitted_on_recovery():
             _t.sleep(0.06)
         assert r.live_replica_count() == 3
         assert fakes[2].calls > 0
+        assert r.stats()["readmissions"] == 1
     finally:
         r.close()
 
@@ -419,6 +426,7 @@ def test_all_dead_failure_policy_open_and_closed():
             resp = r.should_rate_limit(req)
             assert resp.overall_code == want
             assert [s.code for s in resp.statuses] == [want, want]
+            assert r.stats()["fallback_descriptors"] >= 2
         finally:
             r.close()
 
