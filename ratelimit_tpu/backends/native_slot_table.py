@@ -179,9 +179,15 @@ def decide_reconstruct(
 
     Returns (codes i32, remaining i64, befores i64, afters i64,
     over i64, near i64, within i64, shadow i64, set_lc bool), all
-    length n.  Caller guarantees the lib is available.
+    length n.  Raises RuntimeError if the native lib is unavailable
+    (callers normally gate on available() first).
     """
     lib = _get_lib()
+    if lib is None:
+        raise RuntimeError(
+            "native decide library unavailable — check available() "
+            "before calling decide_reconstruct()"
+        )
     n = len(hits)
     g = len(afters_g)
     afters_g = np.ascontiguousarray(afters_g, dtype=np.uint32)

@@ -134,18 +134,41 @@ def _failure_status_name(exc: BaseException) -> Optional[str]:
 
 
 def _is_replica_failure(
-    exc: BaseException, effective_timeout_s: float
+    exc: BaseException,
+    effective_timeout_s: float,
+    hang_min_budget_s: float = _HANG_MIN_BUDGET_S,
 ) -> bool:
     """`effective_timeout_s` is the timeout that could actually have
-    expired: min(caller budget, transport ceiling)."""
+    expired: min(caller budget, transport ceiling).
+    `hang_min_budget_s` is the router's derived hang floor (see
+    ReplicaRouter.__init__) so a deliberately-low transport ceiling
+    still ejects hung replicas."""
     name = _failure_status_name(exc)
     if name is None:
-        # Non-gRPC transport exceptions (socket errors, in-process
-        # fakes raising RuntimeError): replica failure.
-        return True
+        # A timeout from a non-gRPC transport (socket.timeout on one
+        # enforcing the caller budget itself) is the DEADLINE_EXCEEDED
+        # analog: hang-floor-gated, so tight caller budgets expiring
+        # against slow-but-healthy replicas never eject.
+        if isinstance(exc, TimeoutError):
+            return effective_timeout_s >= hang_min_budget_s
+        # Other CONNECTION-shaped exceptions (refused/reset, DNS,
+        # socket errors — all OSError) count unconditionally.  A
+        # proxy-side programming error (TypeError, AttributeError)
+        # must propagate as the bug it is, not eject healthy replicas
+        # one by one into a fake cluster outage.
+        return isinstance(exc, OSError)
     if name == "DEADLINE_EXCEEDED":
-        return effective_timeout_s >= _HANG_MIN_BUDGET_S
+        return effective_timeout_s >= hang_min_budget_s
     return name in _FAILURE_STATUS_NAMES
+
+
+def _is_timeout_shaped(exc: BaseException) -> bool:
+    """True for any expiry-shaped error, regardless of which timeout
+    was binding (gRPC DEADLINE_EXCEEDED or a socket timeout)."""
+    return (
+        _failure_status_name(exc) == "DEADLINE_EXCEEDED"
+        or isinstance(exc, TimeoutError)
+    )
 
 
 class _Circuit:
@@ -229,6 +252,21 @@ class ReplicaRouter:
         self.readmit_after_s = float(readmit_after_s)
         self.failure_policy = failure_policy
         self.transport_ceiling_s = float(transport_ceiling_s)
+        # Hang classification floor: a DEADLINE_EXCEEDED ejects only
+        # when the expired timeout was at least this long.  Derived
+        # from the ceiling so a deliberately-low --max-subcall-seconds
+        # (< _HANG_MIN_BUDGET_S) still ejects blackholed replicas —
+        # at a low ceiling every expiry IS the ceiling expiring, not a
+        # tight caller budget racing a merely-slow replica.
+        self._hang_floor_s = min(_HANG_MIN_BUDGET_S, self.transport_ceiling_s)
+        if self.transport_ceiling_s < _HANG_MIN_BUDGET_S:
+            logger.warning(
+                "transport ceiling %.2fs is below the %.1fs hang floor; "
+                "DEADLINE_EXCEEDED at >=%.2fs now counts toward ejection",
+                self.transport_ceiling_s,
+                _HANG_MIN_BUDGET_S,
+                self._hang_floor_s,
+            )
         self._circuits = [_Circuit() for _ in replica_ids]
         self._health_lock = threading.Lock()
         # Failover observability (the redis pool-gauge analog,
@@ -275,6 +313,12 @@ class ReplicaRouter:
     # the transport's no-deadline backstop, so a probe hung on a
     # blackholed replica cannot block the next probe forever.
     _PROBE_CLAIM_S = 30.0
+
+    # Zero-descriptor walk bounds: per-attempt probe timeout (at the
+    # hang floor, so an expiry still classifies as a hang and ejects)
+    # and the whole-walk budget.
+    _EMPTY_PROBE_TIMEOUT_S = 5.0
+    _EMPTY_WALK_BUDGET_S = 10.0
 
     def _candidates_claiming(self) -> tuple:
         """(candidate indices, claimed-probe indices): circuit closed,
@@ -381,7 +425,7 @@ class ReplicaRouter:
             # Exception, not BaseException: KeyboardInterrupt /
             # SystemExit must propagate, never masquerade as a dead
             # replica.
-            if not _is_replica_failure(e, effective):
+            if not _is_replica_failure(e, effective, self._hang_floor_s):
                 self._release_probes([idx])
                 raise
             self._record_failure(idx, e)
@@ -492,14 +536,58 @@ class ReplicaRouter:
             # A replica answers the empty/error case so the wire
             # behavior (INVALID_ARGUMENT on empty domain etc.) is the
             # service's own, not a router invention; walk the live set
-            # on replica failure.
+            # on replica failure.  The walk is TIME-bounded, not
+            # count-bounded: fast failures (connection refused) still
+            # reach a healthy later candidate, but the request carries
+            # no counter state, so hung-but-not-yet-ejected replicas
+            # get a short per-attempt probe timeout and the whole walk
+            # stops at _EMPTY_WALK_BUDGET_S — without this, each hung
+            # candidate would burn the full transport ceiling (30s
+            # default) and one empty request could pin a worker
+            # thread for minutes.
+            walk_deadline = time.monotonic() + self._EMPTY_WALK_BUDGET_S
+
+            def probe_remaining() -> Optional[float]:
+                left = remaining()  # caller-deadline expiry propagates
+                # Floored: the loop's walk_deadline check races this
+                # by a hair; a zero/negative timeout would surface a
+                # spurious DEADLINE_EXCEEDED to a deadline-less caller.
+                cap = max(
+                    0.05,
+                    min(
+                        self._EMPTY_PROBE_TIMEOUT_S,
+                        walk_deadline - time.monotonic(),
+                    ),
+                )
+                return cap if left is None else min(left, cap)
+
             untouched = set(claimed)
             try:
                 for idx in cand:
+                    if time.monotonic() >= walk_deadline:
+                        break
                     untouched.discard(idx)
                     try:
-                        return self._checked_call(idx, request, remaining)
+                        return self._checked_call(
+                            idx, request, probe_remaining
+                        )
                     except _ReplicaCallError:
+                        continue
+                    except DeadlineExceededError:
+                        raise  # the CALLER's budget expired pre-call
+                    except Exception as e:
+                        # A probe-cap expiry below the hang floor is
+                        # re-raised by _checked_call as ambiguous; in
+                        # THIS walk the cap is ours, so if the caller
+                        # still has budget the expiry was the probe's
+                        # — a hang on this candidate: record it and
+                        # walk on.  remaining() raising here means the
+                        # caller's own budget was the binding timeout:
+                        # that propagates as the deadline error it is.
+                        if not _is_timeout_shaped(e):
+                            raise
+                        remaining()
+                        self._record_failure(idx, e)
                         continue
                 return self._fallback_response(0)
             finally:

@@ -572,3 +572,207 @@ def test_half_open_probe_is_single_flight_per_period():
         assert 0 in third
     finally:
         r.close()
+
+def test_low_transport_ceiling_still_ejects_hung_replicas():
+    """r4 ADVICE: a --max-subcall-seconds below the 5s hang floor must
+    not silently disable hang ejection.  The floor derives down to the
+    ceiling: at a 1s ceiling, a DEADLINE_EXCEEDED whose effective
+    timeout was the full ceiling classifies as a hang and ejects."""
+
+    class _Deadline(Exception):
+        def code(self):
+            class _C:
+                name = "DEADLINE_EXCEEDED"
+
+            return _C()
+
+    def blackholed(req, timeout_s=None):
+        raise _Deadline()  # the 1s transport ceiling expired
+
+    r = ReplicaRouter(
+        ["r0:1"], [blackholed], eject_after=1, transport_ceiling_s=1.0
+    )
+    try:
+        req = _request("basic", [[("key1", "x")]])
+        # No caller deadline: the ceiling is the effective timeout.
+        resp = r.should_rate_limit(req)
+        assert resp.overall_code == rls_pb2.RateLimitResponse.OK
+        assert r.live_replica_count() == 0  # ejected, not inert
+        # But a caller budget BELOW the derived floor still never
+        # ejects: tight-deadline traffic can't flip healthy replicas.
+        r2 = ReplicaRouter(
+            ["r0:1"], [blackholed], eject_after=1, transport_ceiling_s=1.0
+        )
+        try:
+            with pytest.raises(_Deadline):
+                r2.should_rate_limit(req, timeout_s=0.3)
+            assert r2.live_replica_count() == 1
+        finally:
+            r2.close()
+    finally:
+        r.close()
+
+
+def test_programming_errors_propagate_without_ejection():
+    """r4 ADVICE: a proxy-side bug (TypeError/AttributeError in a
+    transport wrapper) must surface as the bug it is — never eject
+    healthy replicas into a fake cluster outage."""
+    calls = {"n": 0}
+
+    def buggy_wrapper(req, timeout_s=None):
+        calls["n"] += 1
+        raise TypeError("unexpected keyword argument 'metadata'")
+
+    r = ReplicaRouter(["r0:1"], [buggy_wrapper], eject_after=1)
+    try:
+        req = _request("basic", [[("key1", "x")]])
+        for _ in range(3):
+            with pytest.raises(TypeError):
+                r.should_rate_limit(req)
+        assert r.live_replica_count() == 1  # never ejected
+        assert calls["n"] == 3
+    finally:
+        r.close()
+
+
+def test_zero_descriptor_walk_is_time_bounded():
+    """r4 ADVICE: the empty-request path carries no counter state, so
+    hung candidates get a short per-attempt probe timeout and the walk
+    has an overall time budget — but FAST failures still walk on to a
+    healthy later candidate (the wire behavior stays the service's
+    own, not a router invention)."""
+    attempts = []
+
+    def dead(i):
+        def t(req, timeout_s=None):
+            attempts.append((i, timeout_s))
+            raise ConnectionError("down")
+
+        return t
+
+    def healthy(req, timeout_s=None):
+        attempts.append(("ok", timeout_s))
+        return rls_pb2.RateLimitResponse(
+            overall_code=rls_pb2.RateLimitResponse.OK
+        )
+
+    # Two fast-failing candidates before a healthy one: reached.
+    r = ReplicaRouter(
+        ["r0:1", "r1:1", "r2:1"],
+        [dead(0), dead(1), healthy],
+        eject_after=0,
+    )
+    try:
+        req = rls_pb2.RateLimitRequest(domain="basic")  # no descriptors
+        resp = r.should_rate_limit(req)
+        assert resp.overall_code == rls_pb2.RateLimitResponse.OK
+        assert attempts[-1][0] == "ok"
+        # Every attempt ran under the short probe timeout, not the
+        # 30s transport ceiling — hung replicas can't pin the thread.
+        assert all(
+            t is not None and t <= ReplicaRouter._EMPTY_PROBE_TIMEOUT_S
+            for _i, t in attempts
+        )
+    finally:
+        r.close()
+
+    # All dead: the failure policy answers after a bounded walk.
+    attempts.clear()
+    ids = [f"r{i}:1" for i in range(5)]
+    r = ReplicaRouter(ids, [dead(i) for i in range(5)], eject_after=0)
+    try:
+        req = rls_pb2.RateLimitRequest(domain="basic")
+        resp = r.should_rate_limit(req)
+        assert resp.overall_code == rls_pb2.RateLimitResponse.OK
+        assert len(attempts) == 5  # fast failures: full walk, no 429
+    finally:
+        r.close()
+
+def test_socket_timeout_respects_hang_floor():
+    """A TimeoutError from a non-gRPC transport is the
+    DEADLINE_EXCEEDED analog: hang-floor-gated, so a tight caller
+    budget expiring via socket timeout never ejects."""
+    import socket
+
+    def slow(req, timeout_s=None):
+        raise socket.timeout("timed out")
+
+    r = ReplicaRouter(["r0:1"], [slow], eject_after=1)
+    try:
+        req = _request("basic", [[("key1", "x")]])
+        for _ in range(3):
+            with pytest.raises(socket.timeout):
+                r.should_rate_limit(req, timeout_s=0.5)
+        assert r.live_replica_count() == 1  # tight budget: no ejection
+        # With a generous budget the same timeout IS a hang: ejected,
+        # and with no survivor the failure policy answers.
+        resp = r.should_rate_limit(req, timeout_s=60.0)
+        assert resp.overall_code == rls_pb2.RateLimitResponse.OK
+        assert r.live_replica_count() == 0
+    finally:
+        r.close()
+
+def test_empty_walk_survives_sub_floor_probe_expiry():
+    """A probe whose own cap expired below the hang floor must not
+    abort the walk with a spurious deadline error: the hang records
+    against the candidate and the walk reaches a healthy replica.
+    Only a genuinely-expired CALLER budget propagates."""
+    from ratelimit_tpu.cluster.router import DeadlineExceededError
+
+    class _Deadline(Exception):
+        def code(self):
+            class _C:
+                name = "DEADLINE_EXCEEDED"
+
+            return _C()
+
+    seen = []
+
+    def hung(i):
+        def t(req, timeout_s=None):
+            seen.append((i, timeout_s))
+            raise _Deadline()
+
+        return t
+
+    def healthy(req, timeout_s=None):
+        seen.append(("ok", timeout_s))
+        return rls_pb2.RateLimitResponse(
+            overall_code=rls_pb2.RateLimitResponse.OK
+        )
+
+    r = ReplicaRouter(
+        ["r0:1", "r1:1", "r2:1"],
+        [hung(0), hung(1), healthy],
+        eject_after=1,
+    )
+    # Force every probe cap below the 5s hang floor: the exact
+    # ambiguity the walk's own classification must resolve.
+    r._EMPTY_PROBE_TIMEOUT_S = 0.5
+    try:
+        req = rls_pb2.RateLimitRequest(domain="basic")  # no descriptors
+        resp = r.should_rate_limit(req)  # no caller deadline
+        assert resp.overall_code == rls_pb2.RateLimitResponse.OK
+        assert seen[-1][0] == "ok"
+        # The sub-floor expiries counted as hangs: both ejected.
+        assert r.live_replica_count() == 1
+    finally:
+        r.close()
+
+    # Caller's own budget binding: propagates as the deadline error.
+    import time as _t
+
+    def slow(req, timeout_s=None):
+        _t.sleep(0.25)
+        raise _Deadline()
+
+    r2 = ReplicaRouter(["r0:1"], [slow], eject_after=1)
+    r2._EMPTY_PROBE_TIMEOUT_S = 0.5
+    try:
+        with pytest.raises(DeadlineExceededError):
+            r2.should_rate_limit(
+                rls_pb2.RateLimitRequest(domain="basic"), timeout_s=0.2
+            )
+        assert r2.live_replica_count() == 1  # tight budget: no ejection
+    finally:
+        r2.close()
