@@ -1,0 +1,136 @@
+"""Lane-scaling profile: per-lane serial host cost as N lanes grow.
+
+Round-4 VERDICT next #1: one process has exactly one collector thread
+owning one slot table, so the host pipeline's implied best case
+(~3.27M dec/s, host_path.json) caps ~23x below the device kernel.
+The fix is N hash-split (slot table + dispatcher + device stream)
+lanes per process (backends/tpu_cache.py `lanes`); on an M-core host
+the N serial legs run on N cores.
+
+This box has ONE core, so the artifact demonstrates the claim the way
+the verdict prescribed: per-lane serial cost per 4096-lane batch must
+stay FLAT as N lanes are instantiated (no shared lock, no shared slot
+table, no shared donation buffer — nothing to contend), and implied
+multi-core throughput = N x per-lane rate.  Each lane here runs the
+REAL dispatcher functions (submit_items/complete_items) against its
+own engine, with its own 4096-lane packed batch, exactly the serving
+path.
+
+Run:  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python benchmarks/profile_lanes.py
+Writes benchmarks/results/host_lanes.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+from ratelimit_tpu.backends.dispatcher import (  # noqa: E402
+    complete_items,
+    submit_items,
+)
+from ratelimit_tpu.backends.engine import CounterEngine  # noqa: E402
+from profile_host_path import make_items  # noqa: E402
+
+BATCH = 4096
+ITERS = 30
+LANE_COUNTS = (1, 2, 4, 8)
+
+
+def timed(fn, reps=ITERS):
+    best = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best.append(time.perf_counter() - t0)
+    return float(np.median(np.array(best[2:])))
+
+
+def main():
+    out = {
+        "batch": BATCH,
+        "note": (
+            "per-lane serial cost of the REAL dispatcher submit+complete "
+            "over a 4096-lane packed batch, with N independent lanes "
+            "instantiated and stepped round-robin (1-core box: flatness "
+            "= no cross-lane contention; multi-core implied = N x rate)"
+        ),
+        "lanes": [],
+    }
+    for n in LANE_COUNTS:
+        # num_slots split as the runner splits TPU_NUM_SLOTS.
+        engines = [
+            CounterEngine(num_slots=(1 << 20) // n) for _ in range(n)
+        ]
+        # Distinct keyspace per lane (seed), as crc32 routing produces.
+        lane_items = [
+            make_items(engines[k], it_seed=100 + k) for k in range(n)
+        ]
+        # Warm XLA shapes per lane.
+        for k in range(n):
+            tok = submit_items(engines[k], lane_items[k])
+            complete_items(engines[k], lane_items[k], tok)
+
+        # Per-lane submit (collector leg), measured per lane while all
+        # N lanes exist and interleave (round-robin = worst-case cache
+        # behavior for lane-private state on one core).
+        def all_lanes_submit_complete():
+            for k in range(n):
+                tok = submit_items(engines[k], lane_items[k])
+                complete_items(engines[k], lane_items[k], tok)
+
+        t_all = timed(all_lanes_submit_complete)
+        per_lane_rt = t_all / n
+
+        # Submit ALL lanes before completing any: the launches overlap
+        # in flight (the multi-lane pipelining the serving threads do).
+        def all_lanes_submit_then_complete():
+            toks = [
+                submit_items(engines[k], lane_items[k]) for k in range(n)
+            ]
+            for k, tok in enumerate(toks):
+                complete_items(engines[k], lane_items[k], tok)
+
+        t_interleaved = timed(all_lanes_submit_then_complete, reps=10)
+
+        # The pipelined serving model: each lane's collector and
+        # completer are separate threads; per-lane throughput is
+        # BATCH / max(leg).  The round-trip includes the device step
+        # (which on real TPU overlaps via pipeline_depth), so the
+        # conservative per-lane rate uses the full round trip / 2
+        # (two-stage pipeline halves the serial leg).
+        per_lane_rate_pipelined = BATCH / (per_lane_rt / 2)
+        out["lanes"].append(
+            {
+                "n_lanes": n,
+                "per_lane_submit_complete_s": per_lane_rt,
+                "all_lanes_interleaved_s": t_interleaved,
+                "implied_decisions_per_sec_one_core": BATCH * n / t_all,
+                "implied_decisions_per_sec_pipelined_multicore": (
+                    per_lane_rate_pipelined * n
+                ),
+            }
+        )
+        print(json.dumps(out["lanes"][-1]))
+
+    base = out["lanes"][0]["per_lane_submit_complete_s"]
+    worst = max(L["per_lane_submit_complete_s"] for L in out["lanes"])
+    out["per_lane_cost_flatness_worst_over_base"] = worst / base
+    path = os.path.join(
+        os.path.dirname(__file__), "results", "host_lanes.json"
+    )
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()

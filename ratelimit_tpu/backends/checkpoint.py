@@ -42,10 +42,16 @@ def snapshot_engine(engine) -> tuple:
     return engine.export_counts(), engine.slot_table.entries()
 
 
-def write_snapshot(path: str, num_slots: int, counts, entries) -> None:
+def write_snapshot(
+    path: str, num_slots: int, counts, entries, role: str = ""
+) -> None:
     """Serialize + atomically write a snapshot (no pickle: keys are
     stored as concatenated utf-8 bytes + a length array, so restore
-    can run with allow_pickle=False on untrusted files)."""
+    can run with allow_pickle=False on untrusted files).  `role` names
+    the bank's position in the cache topology (e.g. "lane1of4",
+    "per_second") so a topology change can't silently restore one
+    bank's keys into a different-purpose engine whose slot count
+    happens to match."""
     key_bytes = [e[0].encode("utf-8") for e in entries]
     key_lens = np.array([len(b) for b in key_bytes], dtype=np.int64)
     key_blob = np.frombuffer(b"".join(key_bytes), dtype=np.uint8)
@@ -56,6 +62,7 @@ def write_snapshot(path: str, num_slots: int, counts, entries) -> None:
         {
             "version": FORMAT_VERSION,
             "num_slots": num_slots,
+            "role": role,
             "saved_at": time.time(),
         }
     )
@@ -72,17 +79,20 @@ def write_snapshot(path: str, num_slots: int, counts, entries) -> None:
     os.replace(tmp, path)
 
 
-def save_engine(engine, path: str) -> None:
+def save_engine(engine, path: str, role: str = "") -> None:
     """snapshot_engine + write_snapshot in one call (tests, shutdown).
     Callers on the serving path should copy under exclusivity and
     write outside it — see CheckpointManager.checkpoint."""
     counts, entries = snapshot_engine(engine)
-    write_snapshot(path, engine.model.num_slots, counts, entries)
+    write_snapshot(path, engine.model.num_slots, counts, entries, role)
 
 
-def restore_engine(engine, path: str) -> bool:
+def restore_engine(engine, path: str, role: str = "") -> bool:
     """Restore one engine bank from `path`; returns False (and leaves
-    the engine fresh) if the snapshot is missing or incompatible."""
+    the engine fresh) if the snapshot is missing or incompatible.
+    When both sides carry a bank `role`, a mismatch refuses the
+    restore (logged skip-and-start-fresh, like the num_slots guard);
+    snapshots from before roles existed restore as before."""
     if not os.path.exists(path):
         return False
     try:
@@ -90,6 +100,16 @@ def restore_engine(engine, path: str) -> bool:
             meta = json.loads(bytes(z["meta"]).decode())
             if meta.get("version") != FORMAT_VERSION:
                 logger.warning("checkpoint %s: unknown version, skipping", path)
+                return False
+            saved_role = meta.get("role", "")
+            if role and saved_role and saved_role != role:
+                logger.warning(
+                    "checkpoint %s: bank role %r != expected %r "
+                    "(topology changed), skipping",
+                    path,
+                    saved_role,
+                    role,
+                )
                 return False
             if meta.get("num_slots") != engine.model.num_slots:
                 logger.warning(
@@ -144,11 +164,31 @@ class CheckpointManager:
     def _bank_path(self, idx: int) -> str:
         return os.path.join(self.directory, f"bank{idx}.npz")
 
+    def _bank_roles(self) -> list:
+        """Topology names for each engines() position: lanes by
+        index/count, the per-second bank by name, plain banks
+        otherwise — the restore guard that keeps a topology change
+        from restoring one bank's keys into a different-purpose
+        engine (see restore_engine)."""
+        engines = self.cache.engines()
+        lanes = getattr(self.cache, "lanes", None)
+        per_second = getattr(self.cache, "per_second_engine", None)
+        roles = []
+        for idx, e in enumerate(engines):
+            if lanes is not None and idx < len(lanes) and e is lanes[idx]:
+                roles.append(f"lane{idx}of{len(lanes)}")
+            elif per_second is not None and e is per_second:
+                roles.append("per_second")
+            else:
+                roles.append(f"bank{idx}")
+        return roles
+
     def restore(self) -> int:
         """Restore all banks; returns how many were restored."""
         restored = 0
+        roles = self._bank_roles()
         for idx, engine in enumerate(self.cache.engines()):
-            if restore_engine(engine, self._bank_path(idx)):
+            if restore_engine(engine, self._bank_path(idx), roles[idx]):
                 restored += 1
         if restored and hasattr(self.cache, "on_restored"):
             # Backends with host-side decision state (write-behind's
@@ -161,6 +201,7 @@ class CheckpointManager:
         engine exclusivity (dispatcher thread / inline lock); the
         expensive compression + disk write happen on this thread so
         serving stalls only for the memcpy, not the I/O."""
+        roles = self._bank_roles()
         for idx, engine in enumerate(self.cache.engines()):
             grabbed = {}
 
@@ -173,6 +214,7 @@ class CheckpointManager:
                 engine.model.num_slots,
                 grabbed["counts"],
                 grabbed["entries"],
+                roles[idx],
             )
 
     def start(self) -> None:

@@ -29,7 +29,8 @@ from __future__ import annotations
 
 import random
 import threading
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Union
+from zlib import crc32
 
 import numpy as np
 
@@ -65,7 +66,7 @@ _CAT_SKIP = 3  # shadow rule + cached over-limit: skip counter, OK
 class TpuRateLimitCache:
     def __init__(
         self,
-        engine: CounterEngine,
+        engine: Union[CounterEngine, Sequence[CounterEngine]],
         time_source: Optional[TimeSource] = None,
         per_second_engine: Optional[CounterEngine] = None,
         local_cache: Optional[LocalCache] = None,
@@ -78,7 +79,24 @@ class TpuRateLimitCache:
         pipeline_depth: int = 2,
         unhealthy_after: int = 3,
     ):
-        self.engine = engine
+        """`engine` may be a LIST of engines: N independent host LANES,
+        each with its own slot table, dispatcher thread pair, and
+        device stream.  Keys hash-split across lanes (crc32 of the full
+        cache key), the in-process mirror of the cluster tier's
+        rendezvous split — on an M-core host the N serial collector
+        legs run on N cores, so host throughput scales toward the
+        device kernel instead of capping at one collector thread (the
+        concurrency the reference gets free from goroutine-per-RPC +
+        Redis pipelining, driver_impl.go:94-99).  See docs/HOST_LANES.md."""
+        lanes = (
+            list(engine)
+            if isinstance(engine, (list, tuple))
+            else [engine]
+        )
+        if not lanes:
+            raise ValueError("need at least one engine lane")
+        self.lanes: List[CounterEngine] = lanes
+        self.engine = lanes[0]  # lane 0 (compat surface)
         self.per_second_engine = per_second_engine
         self.time_source = time_source or RealTimeSource()
         self.local_cache = local_cache
@@ -98,20 +116,25 @@ class TpuRateLimitCache:
         # RPC caller thread; a per-engine lock serializes access to the
         # SlotTable and the donated counts buffer, which the dispatcher
         # thread otherwise owns exclusively.
-        self._inline_locks = {id(engine): threading.Lock()}
+        self._inline_locks = {id(e): threading.Lock() for e in self.lanes}
         if per_second_engine is not None:
             self._inline_locks[id(per_second_engine)] = threading.Lock()
 
         self._dispatchers: dict = {}
         if batch_window_us > 0:
-            self._dispatchers[id(engine)] = BatchDispatcher(
-                engine,
-                batch_window_us,
-                batch_limit,
-                name="tpu-dispatcher",
-                pipeline_depth=pipeline_depth,
-                unhealthy_after=unhealthy_after,
-            )
+            for idx, lane in enumerate(self.lanes):
+                self._dispatchers[id(lane)] = BatchDispatcher(
+                    lane,
+                    batch_window_us,
+                    batch_limit,
+                    name=(
+                        "tpu-dispatcher"
+                        if len(self.lanes) == 1
+                        else f"tpu-dispatcher-lane{idx}"
+                    ),
+                    pipeline_depth=pipeline_depth,
+                    unhealthy_after=unhealthy_after,
+                )
             if per_second_engine is not None:
                 self._dispatchers[id(per_second_engine)] = BatchDispatcher(
                     per_second_engine,
@@ -143,8 +166,16 @@ class TpuRateLimitCache:
                 rule.stats.total_hits.add(hits_addend)
 
         categories = np.full(n, _CAT_NONE, dtype=np.int8)
-        engine_rows: List[int] = []  # indices routed to the main bank
+        n_lanes = len(self.lanes)
+        # Index lists per engine bank: one per lane, plus per-second.
+        rows_by_lane: List[List[int]] = [[] for _ in range(n_lanes)]
         per_second_rows: List[int] = []
+        # Pre-encoded keys (lane routing hashes the utf-8 bytes); only
+        # materialized on the multi-lane path so single-lane serving
+        # pays nothing — _make_item re-encodes there as before.
+        enc_keys: Optional[List[Optional[bytes]]] = (
+            [None] * n if n_lanes > 1 else None
+        )
 
         for i, (key, rule) in enumerate(zip(keys, limits)):
             if key.key == "":
@@ -157,20 +188,30 @@ class TpuRateLimitCache:
             categories[i] = _CAT_ENGINE
             if self.per_second_engine is not None and key.per_second:
                 per_second_rows.append(i)
+            elif n_lanes == 1:
+                rows_by_lane[0].append(i)
             else:
-                engine_rows.append(i)
+                b = key.key.encode("utf-8")
+                enc_keys[i] = b
+                rows_by_lane[crc32(b) % n_lanes].append(i)
 
         statuses: List[Optional[DescriptorStatus]] = [None] * n
 
+        pairs = [
+            (lane, rows) for lane, rows in zip(self.lanes, rows_by_lane)
+        ]
+        pairs.append((self.per_second_engine, per_second_rows))
         items: List[tuple] = []  # (engine, WorkItem)
-        for engine, rows in (
-            (self.engine, engine_rows),
-            (self.per_second_engine, per_second_rows),
-        ):
+        for engine, rows in pairs:
             if not rows:
                 continue
             items.append(
-                (engine, self._make_item(rows, keys, limits, hits_addend, now, statuses))
+                (
+                    engine,
+                    self._make_item(
+                        rows, keys, limits, hits_addend, now, statuses, enc_keys
+                    ),
+                )
             )
 
         # Submit all banks first, then wait: the two banks' device
@@ -306,8 +347,13 @@ class TpuRateLimitCache:
                 )
 
     def engines(self):
-        """All live counter banks, main first (checkpoint surface)."""
-        out = [self.engine]
+        """All live counter banks, lanes first in lane order, then the
+        per-second bank (checkpoint surface; bank indices must be
+        stable across restarts — a changed TPU_NUM_LANES restores keys
+        into the wrong lane, where they age out via gc while their
+        counters restart, the same amnesia envelope as a cluster
+        membership change)."""
+        out = list(self.lanes)
         if self.per_second_engine is not None:
             out.append(self.per_second_engine)
         return out
@@ -336,9 +382,7 @@ class TpuRateLimitCache:
         serving starts — it steps the engines directly."""
         import numpy as np
 
-        for engine in (self.engine, self.per_second_engine):
-            if engine is None:
-                continue
+        for engine in self.engines():
             from .engine import HostBatch
 
             for bucket in engine.buckets:
@@ -373,6 +417,7 @@ class TpuRateLimitCache:
         hits_addend: int,
         now: int,
         statuses: List[Optional[DescriptorStatus]],
+        enc_keys: Optional[List[Optional[bytes]]] = None,
     ) -> WorkItem:
         """Pack this request's engine-bound lanes into arrays HERE, on
         the RPC thread: the dispatcher's serial collector then only
@@ -404,7 +449,12 @@ class TpuRateLimitCache:
                 ) + unit_to_divider(unit)
             if jitters is not None:
                 e += jitters[j]
-            b = keys[i].key.encode("utf-8")
+            # Multi-lane routing already encoded the key; reuse it.
+            b = (
+                enc_keys[i]
+                if enc_keys is not None and enc_keys[i] is not None
+                else keys[i].key.encode("utf-8")
+            )
             enc.append(b)
             meta[j] = (
                 e,

@@ -95,14 +95,20 @@ def create_limiter(s: Settings, stats_manager: Manager, local_cache, time_source
         from .backends.tpu_cache import TpuRateLimitCache
 
         sharded = backend == "tpu-sharded"
-        engine = _make_engine(s, sharded, s.tpu_num_slots)
+        n_lanes = max(1, int(s.tpu_num_lanes))
+        # TPU_NUM_SLOTS is the total budget: each lane serves ~1/N of
+        # the hash-split keyspace from a 1/N-sized table.
+        per_lane_slots = max(1, s.tpu_num_slots // n_lanes)
+        engines = [
+            _make_engine(s, sharded, per_lane_slots) for _ in range(n_lanes)
+        ]
         per_second_engine = (
             _make_engine(s, sharded, s.tpu_per_second_num_slots)
             if s.tpu_per_second
             else None
         )
         return TpuRateLimitCache(
-            engine,
+            engines if n_lanes > 1 else engines[0],
             time_source=time_source,
             per_second_engine=per_second_engine,
             local_cache=local_cache,

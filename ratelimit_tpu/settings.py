@@ -136,6 +136,14 @@ class Settings:
     # settings.go:62-92; the dual per-second engine mirrors
     # REDIS_PERSECOND's second instance).
     tpu_num_slots: int = 1 << 20
+    # Independent host serving lanes: the keyspace hash-splits across
+    # N (slot table + dispatcher + device stream) triples so the
+    # serial collector/completer legs run on N cores (the in-process
+    # mirror of the cluster tier's rendezvous split; the concurrency
+    # the reference gets from goroutine-per-RPC + Redis pipelining,
+    # driver_impl.go:94-99).  TPU_NUM_SLOTS is the TOTAL across lanes.
+    # See docs/HOST_LANES.md.
+    tpu_num_lanes: int = 1
     tpu_per_second: bool = False
     tpu_per_second_num_slots: int = 1 << 20
     tpu_batch_buckets: List[int] = field(
@@ -212,6 +220,7 @@ def new_settings() -> Settings:
         ),
         header_ratelimit_reset=_env_str("LIMIT_RESET_HEADER", "RateLimit-Reset"),
         tpu_num_slots=_env_int("TPU_NUM_SLOTS", 1 << 20),
+        tpu_num_lanes=_env_int("TPU_NUM_LANES", 1),
         tpu_per_second=_env_bool("TPU_PERSECOND", False),
         tpu_per_second_num_slots=_env_int("TPU_PERSECOND_NUM_SLOTS", 1 << 20),
         tpu_batch_buckets=_env_int_list(
