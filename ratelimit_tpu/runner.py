@@ -239,6 +239,24 @@ class Runner:
             # active-connection health analog, driver_impl.go:31-52).
             self.cache.bind_health(self.health)
 
+        credentials = None
+        if bool(s.grpc_server_tls_cert) != bool(s.grpc_server_tls_key):
+            # A half-configured pair must fail startup, never silently
+            # serve rate-limit traffic in cleartext.
+            raise ValueError(
+                "GRPC_SERVER_TLS_CERT and GRPC_SERVER_TLS_KEY must be "
+                "set together (got cert="
+                f"{s.grpc_server_tls_cert!r}, key={s.grpc_server_tls_key!r})"
+            )
+        if s.grpc_server_tls_cert:
+            # TLS / mTLS listener (the REDIS_TLS analog; see Settings).
+            from .server.grpc_server import server_credentials
+
+            credentials = server_credentials(
+                s.grpc_server_tls_cert,
+                s.grpc_server_tls_key,
+                s.grpc_server_tls_ca,
+            )
         self.grpc_server = create_grpc_server(
             self.service,
             self.health,
@@ -247,6 +265,8 @@ class Runner:
             port=s.grpc_port,
             max_connection_age_s=s.grpc_max_connection_age,
             max_connection_age_grace_s=s.grpc_max_connection_age_grace,
+            credentials=credentials,
+            auth_token=s.grpc_auth_token,
         )
         self.grpc_server.start()
 
@@ -269,6 +289,15 @@ class Runner:
                 srv_refresh_s=s.statsd_srv_refresh_s,
             )
             self.statsd.start()
+
+        if s.gc_tuning:
+            # After all startup allocation (engines, kernels, config,
+            # servers): move it out of the gc's scan set so serving-
+            # path collections stay small.  See Settings.gc_tuning.
+            import gc
+
+            gc.collect()
+            gc.freeze()
 
         logger.warning(
             "ratelimit serving: http=%s grpc=%s debug=%s backend=%s",

@@ -50,18 +50,48 @@ class ServerReporter:
         self.store.timer(base + ".response_time").add_duration_ms(elapsed_s * 1e3)
 
 
+# Optional per-RPC stage-timestamp sink (the transport half of the
+# pipeline trace, r4 VERDICT next #2): when set via set_stage_sink, the
+# handler reports (recv, decoded, serviced, serialized) perf_counter
+# stamps per ShouldRateLimit.  The reference's analog is the
+# response_time interceptor timing the full RPC (metrics.go:37-46);
+# this decomposes it.  A one-element list so the live handler closure
+# sees updates; the per-call cost when unset is one load + None check.
+_stage_sink = [None]
+
+
+def set_stage_sink(fn) -> None:
+    """fn(recv, decoded, serviced, serialized) or None to disable.
+    Profiling seam (benchmarks/closed_loop_p99.py); not a stable API."""
+    _stage_sink[0] = fn
+
+
 def _ratelimit_handler(service, reporter: Optional[ServerReporter]):
+    serialize = rls_pb2.RateLimitResponse.SerializeToString
+
     def should_rate_limit(request_pb, context):
         start = time.perf_counter()
         try:
             request = request_from_pb(request_pb)
+            sink = _stage_sink[0]
+            t_decoded = time.perf_counter() if sink is not None else 0.0
             try:
                 response = service.should_rate_limit(request)
             except (ServiceError, CacheError) as e:
                 # grpc-go turns a plain returned error into UNKNOWN;
                 # mirror that mapping (service/ratelimit.go:239-265).
                 context.abort(grpc.StatusCode.UNKNOWN, str(e))
-            return response_to_pb(response)
+            # Serialize HERE on the handler thread (the method is
+            # registered with an identity response_serializer): the
+            # bytes leave this function ready to send, so the time
+            # between return and the socket write is purely grpcio —
+            # attribution needs that boundary to be clean.
+            if sink is not None:
+                t_serviced = time.perf_counter()
+            payload = serialize(response_to_pb(response))
+            if sink is not None:
+                sink(start, t_decoded, t_serviced, time.perf_counter())
+            return payload
         finally:
             if reporter is not None:
                 reporter.observe("ShouldRateLimit", time.perf_counter() - start)
@@ -72,7 +102,9 @@ def _ratelimit_handler(service, reporter: Optional[ServerReporter]):
             "ShouldRateLimit": grpc.unary_unary_rpc_method_handler(
                 should_rate_limit,
                 request_deserializer=rls_pb2.RateLimitRequest.FromString,
-                response_serializer=rls_pb2.RateLimitResponse.SerializeToString,
+                # Identity: the handler returns serialized bytes (see
+                # above).  Wire-identical to serializer-side encoding.
+                response_serializer=None,
             )
         },
     )
@@ -132,6 +164,60 @@ def _health_handler(health: HealthChecker):
     )
 
 
+class _AuthInterceptor(grpc.ServerInterceptor):
+    """Shared-secret auth on the RateLimitService (the Redis AUTH
+    analog, reference settings.go:75-77 + dial opts
+    driver_impl.go:70-88): every ShouldRateLimit must carry
+    `authorization: Bearer <token>` metadata.  grpc.health.v1 stays
+    open — load balancers probe without credentials, like the
+    reference keeps its healthcheck outside Redis auth."""
+
+    def __init__(self, token: str):
+        import hmac as _hmac
+
+        self._expect = f"Bearer {token}"
+        self._compare = _hmac.compare_digest
+
+        def deny(request, context):
+            context.abort(
+                grpc.StatusCode.UNAUTHENTICATED,
+                "missing or invalid authorization token",
+            )
+
+        self._deny = grpc.unary_unary_rpc_method_handler(deny)
+
+    def intercept_service(self, continuation, handler_call_details):
+        if handler_call_details.method.startswith(
+            f"/{HEALTH_SERVICE}/"
+        ):
+            return continuation(handler_call_details)
+        for k, v in handler_call_details.invocation_metadata:
+            if k == "authorization" and self._compare(v, self._expect):
+                return continuation(handler_call_details)
+        return self._deny
+
+
+def server_credentials(
+    tls_cert: str, tls_key: str, tls_ca: str = ""
+) -> grpc.ServerCredentials:
+    """TLS (and with `tls_ca`, mutual-TLS) server credentials from PEM
+    file paths — the REDIS_TLS / client-cert analog
+    (settings.go:62-74)."""
+    with open(tls_key, "rb") as f:
+        key = f.read()
+    with open(tls_cert, "rb") as f:
+        cert = f.read()
+    ca = None
+    if tls_ca:
+        with open(tls_ca, "rb") as f:
+            ca = f.read()
+    return grpc.ssl_server_credentials(
+        [(key, cert)],
+        root_certificates=ca,
+        require_client_auth=ca is not None,
+    )
+
+
 def create_grpc_server(
     service,
     health: HealthChecker,
@@ -141,9 +227,15 @@ def create_grpc_server(
     max_connection_age_s: float = 24 * 3600.0,
     max_connection_age_grace_s: float = 3600.0,
     max_workers: int = 32,
+    credentials: Optional[grpc.ServerCredentials] = None,
+    auth_token: str = "",
 ) -> grpc.Server:
     """Build (not start) the server; port 0 picks a free port.  The
-    bound port is stored on the returned server as ``bound_port``."""
+    bound port is stored on the returned server as ``bound_port``.
+    `credentials` switches the listener to TLS/mTLS (see
+    server_credentials); `auth_token` requires bearer-token metadata
+    on RateLimitService RPCs.  Both default off: plaintext, like the
+    reference's REDIS_TLS/REDIS_AUTH defaults."""
     options = [
         # Forces client re-resolution for elastic scaling
         # (settings.go:23-27, README "GRPC Keepalive").
@@ -157,14 +249,21 @@ def create_grpc_server(
             max_workers=max_workers, thread_name_prefix="grpc-rpc"
         ),
         options=options,
+        interceptors=(
+            (_AuthInterceptor(auth_token),) if auth_token else ()
+        ),
     )
     server.add_generic_rpc_handlers(
         (_ratelimit_handler(service, reporter), _health_handler(health))
     )
-    server.bound_port = server.add_insecure_port(f"{host}:{port}")
+    addr = f"{host}:{port}"
+    if credentials is not None:
+        server.bound_port = server.add_secure_port(addr, credentials)
+    else:
+        server.bound_port = server.add_insecure_port(addr)
     if server.bound_port == 0:
         # grpcio reports bind failure as port 0 instead of raising;
         # fail startup like the reference's net.Listen would
         # (server_impl.go:155-162) rather than serving nothing.
-        raise OSError(f"failed to bind gRPC listener on {host}:{port}")
+        raise OSError(f"failed to bind gRPC listener on {addr}")
     return server

@@ -95,6 +95,31 @@ class Settings:
     grpc_max_connection_age: float = 24 * 3600.0
     grpc_max_connection_age_grace: float = 3600.0
 
+    # Transport security + auth for the serving surface — the analog
+    # of the reference's Redis TLS + AUTH knobs (settings.go:62-92,
+    # dial opts driver_impl.go:70-88): here the trust boundary is the
+    # gRPC listener itself (clients/proxy -> replica).  Empty = plain
+    # TCP (the default, like the reference's REDIS_TLS=false).
+    # GRPC_SERVER_TLS_CERT/KEY enable TLS; GRPC_SERVER_TLS_CA
+    # additionally REQUIRES verified client certificates (mTLS).
+    # GRPC_AUTH_TOKEN requires `authorization: Bearer <token>`
+    # metadata on every RateLimitService RPC (grpc.health.v1 stays
+    # open so load balancers can probe).
+    grpc_server_tls_cert: str = ""
+    grpc_server_tls_key: str = ""
+    grpc_server_tls_ca: str = ""
+    grpc_auth_token: str = ""
+
+    # CPython gc tuning for the serving process: after startup, freeze
+    # every live object out of the collector's scan set, so the
+    # stop-the-world collections that DO run (straight into
+    # ShouldRateLimit p99 on a small box) scan only recent
+    # allocations, not the engines/kernels/config graph.  Thresholds
+    # are left at interpreter defaults — raising them was measured to
+    # WORSEN p99 (rarer but longer pauses).  The reference never faces
+    # this: Go's GC is concurrent.  GC_TUNING=false disables.
+    gc_tuning: bool = True
+
     # Logging (settings.go:30-31).
     log_level: str = "WARN"
     log_format: str = "text"
@@ -219,6 +244,11 @@ def new_settings() -> Settings:
             "LIMIT_REMAINING_HEADER", "RateLimit-Remaining"
         ),
         header_ratelimit_reset=_env_str("LIMIT_RESET_HEADER", "RateLimit-Reset"),
+        grpc_server_tls_cert=_env_str("GRPC_SERVER_TLS_CERT", ""),
+        grpc_server_tls_key=_env_str("GRPC_SERVER_TLS_KEY", ""),
+        grpc_server_tls_ca=_env_str("GRPC_SERVER_TLS_CA", ""),
+        grpc_auth_token=_env_str("GRPC_AUTH_TOKEN", ""),
+        gc_tuning=_env_bool("GC_TUNING", True),
         tpu_num_slots=_env_int("TPU_NUM_SLOTS", 1 << 20),
         tpu_num_lanes=_env_int("TPU_NUM_LANES", 1),
         tpu_per_second=_env_bool("TPU_PERSECOND", False),
