@@ -19,7 +19,7 @@ import logging
 import signal
 import threading
 from concurrent import futures
-from typing import List
+from typing import List, Optional
 
 import grpc
 
@@ -34,7 +34,11 @@ logger = logging.getLogger("ratelimit.cluster.proxy")
 RATELIMIT_SERVICE = "envoy.service.ratelimit.v3.RateLimitService"
 
 
-def grpc_transport(channel: grpc.Channel, max_subcall_s: float = 30.0):
+def grpc_transport(
+    channel: grpc.Channel,
+    max_subcall_s: float = 30.0,
+    auth_token: str = "",
+):
     """Unary transport over an (owned) channel, wire-identical to the
     stub the reference's clients use.
 
@@ -44,11 +48,16 @@ def grpc_transport(channel: grpc.Channel, max_subcall_s: float = 30.0):
     the whole server pool, health probes included).  Unlike the r3
     hardcoded clamp this is an explicit, configurable ceiling
     (--max-subcall-seconds); a caller budget SHORTER than the ceiling
-    still governs."""
+    still governs.  `auth_token` attaches the bearer metadata the
+    replicas' auth interceptor requires (the Redis AUTH dial-option
+    analog, reference driver_impl.go:70-88)."""
     method = channel.unary_unary(
         f"/{RATELIMIT_SERVICE}/ShouldRateLimit",
         request_serializer=rls_pb2.RateLimitRequest.SerializeToString,
         response_deserializer=rls_pb2.RateLimitResponse.FromString,
+    )
+    metadata = (
+        (("authorization", f"Bearer {auth_token}"),) if auth_token else None
     )
 
     def call(
@@ -59,9 +68,30 @@ def grpc_transport(channel: grpc.Channel, max_subcall_s: float = 30.0):
             if timeout_s is None
             else min(max_subcall_s, timeout_s)
         )
-        return method(request, timeout=t)
+        return method(request, timeout=t, metadata=metadata)
 
     return call
+
+
+def replica_channel_credentials(
+    ca_path: str, cert_path: str = "", key_path: str = ""
+):
+    """Client-side TLS credentials for proxy->replica channels: `ca`
+    verifies the replica's server cert; cert+key (optional) present a
+    client certificate for mTLS replicas (GRPC_SERVER_TLS_CA set on
+    the replica).  The Redis TLS client-cert analog
+    (settings.go:62-74)."""
+    with open(ca_path, "rb") as f:
+        ca = f.read()
+    cert = key = None
+    if cert_path and key_path:
+        with open(cert_path, "rb") as f:
+            cert = f.read()
+        with open(key_path, "rb") as f:
+            key = f.read()
+    return grpc.ssl_channel_credentials(
+        root_certificates=ca, private_key=key, certificate_chain=cert
+    )
 
 
 def build_router(
@@ -70,11 +100,24 @@ def build_router(
     readmit_after_s: float = 5.0,
     failure_policy: str = "open",
     max_subcall_s: float = 30.0,
+    channel_credentials=None,
+    auth_token: str = "",
 ) -> ReplicaRouter:
-    channels = [grpc.insecure_channel(a) for a in replica_addrs]
+    """`channel_credentials` (replica_channel_credentials) switches
+    the replica channels to TLS/mTLS; `auth_token` adds bearer
+    metadata to every sub-call.  Defaults stay plaintext."""
+    if channel_credentials is not None:
+        channels = [
+            grpc.secure_channel(a, channel_credentials)
+            for a in replica_addrs
+        ]
+    else:
+        channels = [grpc.insecure_channel(a) for a in replica_addrs]
     return ReplicaRouter(
         replica_ids=list(replica_addrs),
-        transports=[grpc_transport(c, max_subcall_s) for c in channels],
+        transports=[
+            grpc_transport(c, max_subcall_s, auth_token) for c in channels
+        ],
         eject_after=eject_after,
         readmit_after_s=readmit_after_s,
         failure_policy=failure_policy,
@@ -197,7 +240,106 @@ def watch_replicas_file(
     return t, stop
 
 
-def make_server(router: ReplicaRouter, host: str, port: int):
+def resolve_srv_initial(
+    record: str,
+    retry_s: float = 2.0,
+    resolve=None,
+    stop: Optional[threading.Event] = None,
+) -> List[str]:
+    """Block until the SRV record resolves to a NON-EMPTY address list
+    (deduped, order-preserved), retrying on failure — a proxy started
+    before DNS converges (a headless service whose pods aren't Ready
+    yet) must wait, not crash-loop; the refresh loop's
+    keep-old-on-error contract starts at boot.  `stop` (tests) aborts
+    the wait with SrvError."""
+    from ..utils.srv import SrvError, server_strings_from_srv
+
+    resolve_fn = resolve or server_strings_from_srv
+    stop = stop or threading.Event()
+    attempt = 0
+    while True:
+        try:
+            addrs = list(dict.fromkeys(resolve_fn(record)))
+            if addrs:
+                return addrs
+            reason = "empty answer set"
+        except Exception as e:
+            reason = repr(e)
+        attempt += 1
+        logger.warning(
+            "initial SRV resolution of %s failed (%s); retry %d in %.1fs",
+            record,
+            reason,
+            attempt,
+            retry_s,
+        )
+        if stop.wait(retry_s):
+            raise SrvError(f"aborted waiting for SRV {record}")
+
+
+def watch_replicas_srv(
+    holder: RouterHolder,
+    record: str,
+    refresh_s: float = 10.0,
+    build=None,
+    resolve=None,
+):
+    """Periodically re-resolve a DNS SRV record (`_rl._tcp.name`) and
+    swap the holder's router when the membership SET changes — the
+    reference's memcached SRV refresh loop
+    (src/srv/srv.go:148-171, src/memcached/cache_impl.go:180-228)
+    applied to replica membership, feeding the SAME swap path as the
+    watched replicas file so ejection/readmission and the rendezvous
+    amnesia envelope compose identically.
+
+    Keep-old-on-error: a failed or EMPTY resolution keeps the current
+    membership and retries next refresh (a flapping DNS server must
+    not flap the cluster; the reference logs and keeps serving too).
+    `resolve` overrides the resolver (tests); default is
+    utils.srv.server_strings_from_srv against the system resolver.
+
+    Returns (thread, stop_event); set the event to stop the watcher.
+    """
+    from ..utils.srv import server_strings_from_srv
+
+    stop = threading.Event()
+    build_fn = build or build_router
+    resolve_fn = resolve or server_strings_from_srv
+
+    def loop() -> None:
+        while not stop.is_set():
+            try:
+                # Dedup preserving order: the same target can appear
+                # under two SRV priorities, and ReplicaRouter rejects
+                # duplicate ids — a duplicated answer must not wedge
+                # membership updates.
+                addrs = list(dict.fromkeys(resolve_fn(record)))
+                if addrs and set(addrs) != set(holder.replica_ids):
+                    holder.swap(build_fn(addrs))
+                    logger.warning(
+                        "cluster membership from SRV %s now %d "
+                        "replicas: %s",
+                        record,
+                        len(addrs),
+                        ",".join(addrs),
+                    )
+            except Exception as e:  # keep-old-on-error, keep refreshing
+                logger.error(
+                    "SRV refresh %s failed (%s); keeping current "
+                    "membership",
+                    record,
+                    e,
+                )
+            stop.wait(refresh_s)
+
+    t = threading.Thread(target=loop, name="replica-srv-watcher", daemon=True)
+    t.start()
+    return t, stop
+
+
+def make_server(
+    router: ReplicaRouter, host: str, port: int, credentials=None
+):
     """Build the proxy's gRPC server; returns (server, bound_port) —
     port 0 selects an ephemeral port (tests).  Serves the standard
     grpc.health.v1 service alongside the rate-limit API (load
@@ -266,7 +408,10 @@ def make_server(router: ReplicaRouter, host: str, port: int):
     )
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
     server.add_generic_rpc_handlers((handler, health_handler))
-    bound = server.add_insecure_port(f"{host}:{port}")
+    if credentials is not None:
+        bound = server.add_secure_port(f"{host}:{port}", credentials)
+    else:
+        bound = server.add_insecure_port(f"{host}:{port}")
     if bound == 0:
         # grpcio returns 0 instead of raising when the bind fails
         # (same quirk handled in server/grpc_server.py:164-168).
@@ -287,9 +432,20 @@ def main(argv=None) -> None:
         help="file of replica addresses, POLLED for live membership "
         "changes (rendezvous: only moved keys reset their window)",
     )
+    g.add_argument(
+        "--replicas-srv",
+        help="DNS SRV record (_rl._tcp.name) resolved for replica "
+        "addresses and periodically RE-resolved for membership "
+        "changes (the reference's memcached SRV discovery, "
+        "srv.go:148-171); host:port identities come from the answers",
+    )
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8082)
     p.add_argument("--poll-seconds", type=float, default=2.0)
+    p.add_argument(
+        "--srv-refresh-seconds", type=float, default=10.0,
+        help="how often --replicas-srv is re-resolved",
+    )
     p.add_argument(
         "--eject-after", type=int, default=3,
         help="consecutive replica failures before ejection from the "
@@ -310,7 +466,50 @@ def main(argv=None) -> None:
         help="ceiling on any single replica sub-call, caller deadline "
         "or not (bounds worker-thread pinning on a blackholed replica)",
     )
+    p.add_argument(
+        "--replica-tls-ca", default="",
+        help="PEM CA verifying replica server certs; enables TLS on "
+        "proxy->replica channels (Redis TLS analog, settings.go:62-74)",
+    )
+    p.add_argument(
+        "--replica-tls-cert", default="",
+        help="PEM client certificate presented to mTLS replicas",
+    )
+    p.add_argument(
+        "--replica-tls-key", default="",
+        help="PEM client key for --replica-tls-cert",
+    )
+    p.add_argument(
+        "--auth-token", default="",
+        help="bearer token attached to every replica sub-call "
+        "(replicas set GRPC_AUTH_TOKEN; Redis AUTH analog)",
+    )
+    p.add_argument(
+        "--tls-cert", default="",
+        help="PEM certificate for the proxy's OWN listener (TLS off "
+        "when empty)",
+    )
+    p.add_argument(
+        "--tls-key", default="",
+        help="PEM key for --tls-cert",
+    )
     args = p.parse_args(argv)
+
+    # Half-configured cert/key pairs fail startup (silent plaintext or
+    # a cert silently not presented would surface as baffling
+    # handshake errors instead of a config error).
+    if bool(args.tls_cert) != bool(args.tls_key):
+        p.error("--tls-cert and --tls-key must be given together")
+    if bool(args.replica_tls_cert) != bool(args.replica_tls_key):
+        p.error(
+            "--replica-tls-cert and --replica-tls-key must be given together"
+        )
+
+    replica_creds = None
+    if args.replica_tls_ca:
+        replica_creds = replica_channel_credentials(
+            args.replica_tls_ca, args.replica_tls_cert, args.replica_tls_key
+        )
 
     def build(addrs_):
         return build_router(
@@ -319,10 +518,16 @@ def main(argv=None) -> None:
             readmit_after_s=args.readmit_after_seconds,
             failure_policy=args.failure_mode,
             max_subcall_s=args.max_subcall_seconds,
+            channel_credentials=replica_creds,
+            auth_token=args.auth_token,
         )
 
     if args.replicas_file:
         addrs = read_replicas_file(args.replicas_file)
+    elif args.replicas_srv:
+        addrs = resolve_srv_initial(
+            args.replicas_srv, retry_s=args.srv_refresh_seconds
+        )
     else:
         addrs = [a.strip() for a in args.replicas.split(",") if a.strip()]
     holder = RouterHolder(build(addrs))
@@ -330,7 +535,19 @@ def main(argv=None) -> None:
         watch_replicas_file(
             holder, args.replicas_file, args.poll_seconds, build=build
         )
-    server, bound = make_server(holder, args.host, args.port)
+    elif args.replicas_srv:
+        watch_replicas_srv(
+            holder,
+            args.replicas_srv,
+            args.srv_refresh_seconds,
+            build=build,
+        )
+    own_creds = None
+    if args.tls_cert and args.tls_key:
+        from ..server.grpc_server import server_credentials
+
+        own_creds = server_credentials(args.tls_cert, args.tls_key)
+    server, bound = make_server(holder, args.host, args.port, own_creds)
     server.start()
     logger.warning(
         "cluster proxy serving :%d over %d replicas", bound, len(addrs)
