@@ -564,7 +564,14 @@ class ReplicaRouter:
             untouched = set(claimed)
             try:
                 for idx in cand:
-                    if time.monotonic() >= walk_deadline:
+                    # The cap THIS attempt will get: failure
+                    # accounting below depends on whether it was the
+                    # full probe timeout or a walk-deadline clamp.
+                    cap_now = min(
+                        self._EMPTY_PROBE_TIMEOUT_S,
+                        walk_deadline - time.monotonic(),
+                    )
+                    if cap_now <= 0:
                         break
                     untouched.discard(idx)
                     try:
@@ -579,15 +586,19 @@ class ReplicaRouter:
                         # A probe-cap expiry below the hang floor is
                         # re-raised by _checked_call as ambiguous; in
                         # THIS walk the cap is ours, so if the caller
-                        # still has budget the expiry was the probe's
-                        # — a hang on this candidate: record it and
-                        # walk on.  remaining() raising here means the
-                        # caller's own budget was the binding timeout:
-                        # that propagates as the deadline error it is.
+                        # still has budget the expiry was the probe's.
+                        # remaining() raising here means the caller's
+                        # own budget was the binding timeout: that
+                        # propagates as the deadline error it is.
                         if not _is_timeout_shaped(e):
                             raise
                         remaining()
-                        self._record_failure(idx, e)
+                        if cap_now >= self._EMPTY_PROBE_TIMEOUT_S:
+                            # Full-length probe expired: a hang.
+                            self._record_failure(idx, e)
+                        # A CLAMPED probe expiring proves nothing — a
+                        # healthy replica's normal latency can exceed
+                        # a near-zero clamp; never eject on it.
                         continue
                 return self._fallback_response(0)
             finally:

@@ -776,3 +776,40 @@ def test_empty_walk_survives_sub_floor_probe_expiry():
         assert r2.live_replica_count() == 1  # tight budget: no ejection
     finally:
         r2.close()
+
+def test_clamped_probe_expiry_never_ejects_healthy_replica():
+    """Near the end of the empty-request walk budget the probe cap
+    clamps toward zero; a healthy replica whose NORMAL latency
+    exceeds that clamp must not record a failure (only full-length
+    probe expiries count as hangs)."""
+
+    class _Deadline(Exception):
+        def code(self):
+            class _C:
+                name = "DEADLINE_EXCEEDED"
+
+            return _C()
+
+    def hung_or_clamped(req, timeout_s=None):
+        raise _Deadline()
+
+    r = ReplicaRouter(
+        ["r0:1", "r1:1"], [hung_or_clamped, hung_or_clamped], eject_after=1
+    )
+    # Walk budget nearly exhausted: every probe cap is clamped far
+    # below the full probe timeout.
+    r._EMPTY_WALK_BUDGET_S = 0.2
+    r._EMPTY_PROBE_TIMEOUT_S = 5.0
+    try:
+        req = rls_pb2.RateLimitRequest(domain="basic")
+        import time as _t
+
+        t0 = _t.monotonic()
+        resp = r.should_rate_limit(req)
+        assert resp.overall_code == rls_pb2.RateLimitResponse.OK
+        # The fakes raised instantly under a clamped cap (0.2s < 5s
+        # probe timeout): nothing may be ejected.
+        assert r.live_replica_count() == 2
+        assert _t.monotonic() - t0 < 2.0
+    finally:
+        r.close()

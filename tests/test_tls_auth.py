@@ -1,0 +1,205 @@
+"""TLS/mTLS + bearer auth on the serving and cluster surfaces
+(round-4 VERDICT missing #3 / next #3).
+
+The reference secures its backend hop with Redis TLS + AUTH
+(settings.go:62-92, dial opts driver_impl.go:70-88).  Here the
+equivalent trust boundaries are the replica's gRPC listener and the
+proxy->replica channels; plaintext stays the default.
+"""
+
+import grpc
+import pytest
+
+from ratelimit_tpu.runner import Runner
+from ratelimit_tpu.settings import Settings
+from ratelimit_tpu.utils.time import PinnedTimeSource
+
+from ratelimit_tpu.server import pb  # noqa: F401
+from envoy.service.ratelimit.v3 import rls_pb2  # noqa: E402
+from grpchealth.v1 import health_pb2  # noqa: E402
+
+from tls_helpers import make_test_pki
+
+YAML = """
+domain: sec
+descriptors:
+  - key: key1
+    rate_limit:
+      unit: minute
+      requests_per_unit: 5
+"""
+
+
+@pytest.fixture(scope="module")
+def pki(tmp_path_factory):
+    return make_test_pki(str(tmp_path_factory.mktemp("pki")))
+
+
+def _runner(tmp_path_factory, name, **settings_kw):
+    root = tmp_path_factory.mktemp(name)
+    config_dir = root / "ratelimit" / "config"
+    config_dir.mkdir(parents=True)
+    (config_dir / "sec.yaml").write_text(YAML)
+    s = Settings(
+        host="127.0.0.1", port=0, grpc_host="127.0.0.1", grpc_port=0,
+        debug_host="127.0.0.1", debug_port=0, use_statsd=False,
+        backend_type="tpu", tpu_num_slots=1 << 10,
+        tpu_batch_window_us=0, tpu_batch_buckets=[8],
+        runtime_path=str(root), runtime_subdirectory="ratelimit",
+        local_cache_size_in_bytes=0, expiration_jitter_max_seconds=0,
+        **settings_kw,
+    )
+    r = Runner(s, time_source=PinnedTimeSource(1_000_000))
+    r.start()
+    return r
+
+
+def _request(value="v"):
+    req = rls_pb2.RateLimitRequest(domain="sec")
+    e = req.descriptors.add().entries.add()
+    e.key, e.value = "key1", value
+    return req
+
+
+def _method(channel):
+    return channel.unary_unary(
+        "/envoy.service.ratelimit.v3.RateLimitService/ShouldRateLimit",
+        request_serializer=rls_pb2.RateLimitRequest.SerializeToString,
+        response_deserializer=rls_pb2.RateLimitResponse.FromString,
+    )
+
+
+def test_tls_listener_serves_and_rejects_plaintext(tmp_path_factory, pki):
+    r = _runner(
+        tmp_path_factory, "tls",
+        grpc_server_tls_cert=pki["server_cert"],
+        grpc_server_tls_key=pki["server_key"],
+    )
+    try:
+        addr = f"127.0.0.1:{r.grpc_server.bound_port}"
+        with open(pki["ca"], "rb") as f:
+            creds = grpc.ssl_channel_credentials(f.read())
+        with grpc.secure_channel(addr, creds) as ch:
+            resp = _method(ch)(_request(), timeout=30)
+            assert resp.overall_code == rls_pb2.RateLimitResponse.OK
+        # A plaintext client cannot speak to a TLS listener.
+        with grpc.insecure_channel(addr) as ch:
+            with pytest.raises(grpc.RpcError):
+                _method(ch)(_request(), timeout=5)
+    finally:
+        r.stop()
+
+
+def test_mtls_requires_client_certificate(tmp_path_factory, pki):
+    r = _runner(
+        tmp_path_factory, "mtls",
+        grpc_server_tls_cert=pki["server_cert"],
+        grpc_server_tls_key=pki["server_key"],
+        grpc_server_tls_ca=pki["ca"],  # require verified client certs
+    )
+    try:
+        addr = f"127.0.0.1:{r.grpc_server.bound_port}"
+        with open(pki["ca"], "rb") as f:
+            ca = f.read()
+        with open(pki["client_cert"], "rb") as f:
+            cert = f.read()
+        with open(pki["client_key"], "rb") as f:
+            key = f.read()
+        good = grpc.ssl_channel_credentials(
+            root_certificates=ca, private_key=key, certificate_chain=cert
+        )
+        with grpc.secure_channel(addr, good) as ch:
+            resp = _method(ch)(_request(), timeout=30)
+            assert resp.overall_code == rls_pb2.RateLimitResponse.OK
+        # TLS without a client certificate: handshake rejected.
+        anon = grpc.ssl_channel_credentials(root_certificates=ca)
+        with grpc.secure_channel(addr, anon) as ch:
+            with pytest.raises(grpc.RpcError):
+                _method(ch)(_request(), timeout=5)
+    finally:
+        r.stop()
+
+
+def test_auth_token_gates_ratelimit_but_not_health(tmp_path_factory):
+    r = _runner(tmp_path_factory, "auth", grpc_auth_token="s3cret")
+    try:
+        addr = f"127.0.0.1:{r.grpc_server.bound_port}"
+        with grpc.insecure_channel(addr) as ch:
+            m = _method(ch)
+            # No token -> UNAUTHENTICATED.
+            with pytest.raises(grpc.RpcError) as ei:
+                m(_request(), timeout=10)
+            assert ei.value.code() == grpc.StatusCode.UNAUTHENTICATED
+            # Wrong token -> UNAUTHENTICATED.
+            with pytest.raises(grpc.RpcError) as ei:
+                m(
+                    _request(), timeout=10,
+                    metadata=(("authorization", "Bearer wrong"),),
+                )
+            assert ei.value.code() == grpc.StatusCode.UNAUTHENTICATED
+            # Right token -> served.
+            resp = m(
+                _request(), timeout=30,
+                metadata=(("authorization", "Bearer s3cret"),),
+            )
+            assert resp.overall_code == rls_pb2.RateLimitResponse.OK
+            # Health stays open (LB probes carry no secrets), like the
+            # reference's healthcheck living outside Redis AUTH.
+            check = ch.unary_unary(
+                "/grpc.health.v1.Health/Check",
+                request_serializer=(
+                    health_pb2.HealthCheckRequest.SerializeToString
+                ),
+                response_deserializer=health_pb2.HealthCheckResponse.FromString,
+            )
+            st = check(health_pb2.HealthCheckRequest(), timeout=10)
+            assert st.status == health_pb2.HealthCheckResponse.SERVING
+    finally:
+        r.stop()
+
+
+def test_proxy_speaks_tls_and_auth_to_replicas(tmp_path_factory, pki):
+    """The full cluster hop, secured: replica with TLS + token; the
+    PRODUCTION transport (build_router with channel credentials +
+    auth token) routes through it."""
+    from ratelimit_tpu.cluster.proxy import (
+        build_router,
+        replica_channel_credentials,
+    )
+
+    r = _runner(
+        tmp_path_factory, "cluster-tls",
+        grpc_server_tls_cert=pki["server_cert"],
+        grpc_server_tls_key=pki["server_key"],
+        grpc_auth_token="cluster-secret",
+    )
+    router = None
+    try:
+        addr = f"127.0.0.1:{r.grpc_server.bound_port}"
+        router = build_router(
+            [addr],
+            channel_credentials=replica_channel_credentials(pki["ca"]),
+            auth_token="cluster-secret",
+        )
+        resp = router.should_rate_limit(_request("via-proxy"))
+        assert resp.overall_code == rls_pb2.RateLimitResponse.OK
+        assert resp.statuses[0].limit_remaining == 4
+
+        # Same channel creds but a missing token: the replica refuses
+        # and the error PROPAGATES (auth failures are application
+        # statuses, not replica-health failures -> no ejection).
+        bad = build_router(
+            [addr],
+            channel_credentials=replica_channel_credentials(pki["ca"]),
+        )
+        try:
+            with pytest.raises(grpc.RpcError) as ei:
+                bad.should_rate_limit(_request("via-proxy"))
+            assert ei.value.code() == grpc.StatusCode.UNAUTHENTICATED
+            assert bad.live_replica_count() == 1  # never ejected
+        finally:
+            bad.close()
+    finally:
+        if router is not None:
+            router.close()
+        r.stop()

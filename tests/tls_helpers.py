@@ -1,0 +1,93 @@
+"""Self-signed test PKI: one CA, leaf certs for localhost.
+
+Used by the TLS/auth tests (and mirrored by openssl commands in the
+e2e TLS scenario).  Test-only material — 1-day validity, generated
+fresh per run.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import rsa
+from cryptography.x509.oid import NameOID
+
+
+def _key():
+    return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+
+def _name(cn: str) -> x509.Name:
+    return x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+
+
+def _write(path: str, data: bytes) -> str:
+    with open(path, "wb") as f:
+        f.write(data)
+    return path
+
+
+def make_test_pki(directory: str) -> dict:
+    """Writes ca.pem, server.pem/server.key, client.pem/client.key
+    under `directory`; returns their paths."""
+    now = datetime.datetime.now(datetime.timezone.utc)
+    ca_key = _key()
+    ca_cert = (
+        x509.CertificateBuilder()
+        .subject_name(_name("ratelimit-test-ca"))
+        .issuer_name(_name("ratelimit-test-ca"))
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None), True)
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    def leaf(cn: str):
+        key = _key()
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(_name(cn))
+            .issuer_name(ca_cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=1))
+            .add_extension(
+                x509.SubjectAlternativeName(
+                    [
+                        x509.DNSName("localhost"),
+                        x509.IPAddress(ipaddress.ip_address("127.0.0.1")),
+                    ]
+                ),
+                False,
+            )
+            .sign(ca_key, hashes.SHA256())
+        )
+        return key, cert
+
+    def pem_cert(c):
+        return c.public_bytes(serialization.Encoding.PEM)
+
+    def pem_key(k):
+        return k.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        )
+
+    s_key, s_cert = leaf("localhost")
+    c_key, c_cert = leaf("ratelimit-test-client")
+    j = lambda n: os.path.join(directory, n)  # noqa: E731
+    return {
+        "ca": _write(j("ca.pem"), pem_cert(ca_cert)),
+        "server_cert": _write(j("server.pem"), pem_cert(s_cert)),
+        "server_key": _write(j("server.key"), pem_key(s_key)),
+        "client_cert": _write(j("client.pem"), pem_cert(c_cert)),
+        "client_key": _write(j("client.key"), pem_key(c_key)),
+    }
