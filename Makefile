@@ -4,14 +4,15 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: all test bench protos native serve check_config smoke_client docker_image e2e e2e-local clean
+.PHONY: all test bench protos native serve check_config smoke_client docker_image e2e e2e-local ci clean
 
 # C++ hot-path library: slot table + decide kernel (auto-built on
-# first import too; this forces it).
+# first import too; this forces it).  Goes through the Python builder
+# so the content stamp is written — a bare g++ call would leave a
+# stamp mismatch and the loader would just rebuild at import.
 native:
-	g++ -O2 -std=c++20 -shared -fPIC \
-	  -o ratelimit_tpu/backends/_libslottable.so \
-	  native/slot_table.cpp native/decide.cpp
+	$(PY) -c "from ratelimit_tpu.backends import native_slot_table as n; \
+	  import sys; sys.exit(0 if n._build() else 1)"
 
 all: test
 
@@ -59,6 +60,11 @@ e2e-local:
 	PY=$(PY) sh integration-test/run-local.sh > integration-test/results/local-e2e.txt 2>&1 \
 	  || { cat integration-test/results/local-e2e.txt; exit 1; }
 	cat integration-test/results/local-e2e.txt
+
+# The full CI recipe (.github/workflows/ci.yaml runs exactly this):
+# native build, tests, black-box e2e, bench smoke on the CPU platform.
+ci: native test e2e-local
+	$(CPU_ENV) PALLAS_AXON_POOL_IPS= $(PY) bench.py
 
 clean:
 	find . -name __pycache__ -type d -prune -exec rm -rf {} \;

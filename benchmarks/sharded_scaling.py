@@ -67,8 +67,14 @@ def main() -> None:
         engine = ShardedCounterEngine(make_mesh(nd), num_slots=NUM_SLOTS)
         widths = []
         bank_counts = []
-        # warm
-        engine.step(batches[0])
+        # Warmup isolation (r4 VERDICT weak #3): the routed cap varies
+        # per batch, so a single warmup step leaves some (bucket,
+        # dtype) shapes uncompiled and XLA compilation lands inside
+        # the timed loop (the old 2-bank row's 9.73ms spike).  Run the
+        # WHOLE sequence once untimed so every shape the timed pass
+        # uses is compiled.
+        for b in batches:
+            engine.step(b)
         engine.reset()
         t0 = time.perf_counter()
         for i, b in enumerate(batches):
