@@ -4,13 +4,13 @@
 # dialing it with --replica-tls-ca/--auth-token and itself listening
 # over TLS.  Verifies: secure end-to-end request, plaintext rejected,
 # missing token rejected, health probe open without credentials.
-# Self-contained: own ports (49081 replica, 49090 proxy), own certs.
+# Self-contained: own ports (59081 replica, 59090 proxy), own certs.
 set -e
 cd "$(dirname "$0")/../.."
 export JAX_PLATFORMS=cpu
 export PALLAS_AXON_POOL_IPS=
 
-for port in 49081 49090; do
+for port in 59070 59080 59081 59090; do
   if "${PY:-python}" -c "import socket,sys; s=socket.socket(); s.settimeout(0.5); sys.exit(0 if s.connect_ex(('127.0.0.1',$port))==0 else 1)"; then
     echo "port $port already bound — stop the stale process first"
     exit 1
@@ -40,7 +40,7 @@ mkdir -p "$RL/r1/ratelimit/config"
 cp examples/ratelimit/config/example.yaml "$RL/r1/ratelimit/config/"
 
 RUNTIME_ROOT="$RL/r1" RUNTIME_SUBDIRECTORY=ratelimit \
-  PORT=49080 GRPC_PORT=49081 DEBUG_PORT=49070 TPU_NUM_SLOTS=65536 \
+  PORT=59080 GRPC_PORT=59081 DEBUG_PORT=59070 TPU_NUM_SLOTS=65536 \
   GRPC_SERVER_TLS_CERT="$RL/server.pem" GRPC_SERVER_TLS_KEY="$RL/server.key" \
   GRPC_AUTH_TOKEN=e2e-secret \
   "${PY:-python}" -m ratelimit_tpu.runner >"$RL/r1.log" 2>&1 &
@@ -49,25 +49,25 @@ PIDS="$PIDS $!"
 up=0
 for i in $(seq 1 90); do
   kill -0 $PIDS 2>/dev/null || { echo "replica died:"; tail -5 "$RL/r1.log"; exit 1; }
-  curl -s -o /dev/null http://localhost:49080/healthcheck && { up=1; break; }
+  curl -s -o /dev/null http://localhost:59080/healthcheck && { up=1; break; }
   sleep 1
 done
 [ "$up" = "1" ] || { echo "replica never came up"; tail -5 "$RL/r1.log"; exit 1; }
 
 "${PY:-python}" -m ratelimit_tpu.cluster.proxy \
-  --replicas 127.0.0.1:49081 \
+  --replicas 127.0.0.1:59081 \
   --replica-tls-ca "$RL/ca.pem" --auth-token e2e-secret \
   --tls-cert "$RL/server.pem" --tls-key "$RL/server.key" \
-  --host 127.0.0.1 --port 49090 >"$RL/proxy.log" 2>&1 &
+  --host 127.0.0.1 --port 59090 >"$RL/proxy.log" 2>&1 &
 PROXY_PID=$!
 PIDS="$PIDS $PROXY_PID"
 up=0
 for i in $(seq 1 30); do
   kill -0 "$PROXY_PID" 2>/dev/null || { echo "proxy died:"; tail -5 "$RL/proxy.log"; exit 1; }
-  "${PY:-python}" -c "import socket,sys; s=socket.socket(); s.settimeout(0.5); sys.exit(0 if s.connect_ex(('127.0.0.1',49090))==0 else 1)" && { up=1; break; }
+  "${PY:-python}" -c "import socket,sys; s=socket.socket(); s.settimeout(0.5); sys.exit(0 if s.connect_ex(('127.0.0.1',59090))==0 else 1)" && { up=1; break; }
   sleep 1
 done
-[ "$up" = "1" ] || { echo "proxy never bound 49090"; tail -5 "$RL/proxy.log"; exit 1; }
+[ "$up" = "1" ] || { echo "proxy never bound 59090"; tail -5 "$RL/proxy.log"; exit 1; }
 
 # All four assertions in one secure client.
 RL_DIR="$RL" "${PY:-python}" - << 'EOF'
@@ -93,12 +93,12 @@ e = req.descriptors.add().entries.add()
 e.key, e.value = "foo", "tls-e2e"
 
 # 1. Secure hop through the TLS proxy to the TLS+auth replica.
-with grpc.secure_channel("localhost:49090", creds) as ch:
+with grpc.secure_channel("localhost:59090", creds) as ch:
     resp = method(ch)(req, timeout=30)
     assert resp.overall_code == rls_pb2.RateLimitResponse.OK, resp
 
 # 2. Plaintext to the TLS replica: rejected.
-with grpc.insecure_channel("127.0.0.1:49081") as ch:
+with grpc.insecure_channel("127.0.0.1:59081") as ch:
     try:
         method(ch)(req, timeout=5)
         sys.exit("plaintext request unexpectedly succeeded")
@@ -106,7 +106,7 @@ with grpc.insecure_channel("127.0.0.1:49081") as ch:
         pass
 
 # 3. TLS to the replica but no token: UNAUTHENTICATED.
-with grpc.secure_channel("localhost:49081", creds) as ch:
+with grpc.secure_channel("localhost:59081", creds) as ch:
     try:
         method(ch)(req, timeout=10)
         sys.exit("tokenless request unexpectedly succeeded")
@@ -114,7 +114,7 @@ with grpc.secure_channel("localhost:49081", creds) as ch:
         assert err.code() == grpc.StatusCode.UNAUTHENTICATED, err.code()
 
 # 4. Health probe open without credentials on the replica.
-with grpc.secure_channel("localhost:49081", creds) as ch:
+with grpc.secure_channel("localhost:59081", creds) as ch:
     check = ch.unary_unary(
         "/grpc.health.v1.Health/Check",
         request_serializer=health_pb2.HealthCheckRequest.SerializeToString,

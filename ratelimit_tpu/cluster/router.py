@@ -583,22 +583,22 @@ class ReplicaRouter:
                     except DeadlineExceededError:
                         raise  # the CALLER's budget expired pre-call
                     except Exception as e:
-                        # A probe-cap expiry below the hang floor is
-                        # re-raised by _checked_call as ambiguous; in
-                        # THIS walk the cap is ours, so if the caller
-                        # still has budget the expiry was the probe's.
-                        # remaining() raising here means the caller's
-                        # own budget was the binding timeout: that
-                        # propagates as the deadline error it is.
+                        # A timeout-shaped error _checked_call did NOT
+                        # classify as a hang (it records those itself:
+                        # a full-length probe's effective timeout is
+                        # min(probe, ceiling) >= the hang floor, so
+                        # genuine hangs arrive as _ReplicaCallError
+                        # above).  What lands here is ambiguous — a
+                        # clamped near-zero probe cap, or a tight
+                        # budget racing a merely-slow replica — and
+                        # proves nothing about replica health: walk on
+                        # without failure accounting.  remaining()
+                        # raising means the CALLER's budget was the
+                        # binding timeout: that propagates as the
+                        # deadline error it is.
                         if not _is_timeout_shaped(e):
                             raise
                         remaining()
-                        if cap_now >= self._EMPTY_PROBE_TIMEOUT_S:
-                            # Full-length probe expired: a hang.
-                            self._record_failure(idx, e)
-                        # A CLAMPED probe expiring proves nothing — a
-                        # healthy replica's normal latency can exceed
-                        # a near-zero clamp; never eject on it.
                         continue
                 return self._fallback_response(0)
             finally:

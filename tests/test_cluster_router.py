@@ -754,8 +754,10 @@ def test_empty_walk_survives_sub_floor_probe_expiry():
         resp = r.should_rate_limit(req)  # no caller deadline
         assert resp.overall_code == rls_pb2.RateLimitResponse.OK
         assert seen[-1][0] == "ok"
-        # The sub-floor expiries counted as hangs: both ejected.
-        assert r.live_replica_count() == 1
+        # Sub-floor expiries prove nothing about replica health: no
+        # ejection (genuine hangs are recorded by _checked_call's
+        # hang-floor classification, not by this walk).
+        assert r.live_replica_count() == 3
     finally:
         r.close()
 
