@@ -314,11 +314,17 @@ class ReplicaRouter:
     # blackholed replica cannot block the next probe forever.
     _PROBE_CLAIM_S = 30.0
 
-    # Zero-descriptor walk bounds: per-attempt probe timeout (at the
-    # hang floor, so an expiry still classifies as a hang and ejects)
-    # and the whole-walk budget.
+    # Zero-descriptor walk bounds: per-attempt probe timeout and the
+    # whole-walk budget.  The EFFECTIVE probe timeout is
+    # max(_EMPTY_PROBE_TIMEOUT_S, hang floor) — see _probe_timeout_s —
+    # so a full-length probe expiry always classifies as a hang in
+    # _checked_call; lowering this constant below the floor tightens
+    # nothing and must not silently disable empty-walk ejection.
     _EMPTY_PROBE_TIMEOUT_S = 5.0
     _EMPTY_WALK_BUDGET_S = 10.0
+
+    def _probe_timeout_s(self) -> float:
+        return max(self._EMPTY_PROBE_TIMEOUT_S, self._hang_floor_s)
 
     def _candidates_claiming(self) -> tuple:
         """(candidate indices, claimed-probe indices): circuit closed,
@@ -546,6 +552,7 @@ class ReplicaRouter:
             # default) and one empty request could pin a worker
             # thread for minutes.
             walk_deadline = time.monotonic() + self._EMPTY_WALK_BUDGET_S
+            probe_timeout = self._probe_timeout_s()
 
             def probe_remaining() -> Optional[float]:
                 left = remaining()  # caller-deadline expiry propagates
@@ -555,7 +562,7 @@ class ReplicaRouter:
                 cap = max(
                     0.05,
                     min(
-                        self._EMPTY_PROBE_TIMEOUT_S,
+                        probe_timeout,
                         walk_deadline - time.monotonic(),
                     ),
                 )
@@ -568,7 +575,7 @@ class ReplicaRouter:
                     # accounting below depends on whether it was the
                     # full probe timeout or a walk-deadline clamp.
                     cap_now = min(
-                        self._EMPTY_PROBE_TIMEOUT_S,
+                        probe_timeout,
                         walk_deadline - time.monotonic(),
                     )
                     if cap_now <= 0:

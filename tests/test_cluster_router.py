@@ -712,11 +712,12 @@ def test_socket_timeout_respects_hang_floor():
     finally:
         r.close()
 
-def test_empty_walk_survives_sub_floor_probe_expiry():
-    """A probe whose own cap expired below the hang floor must not
-    abort the walk with a spurious deadline error: the hang records
-    against the candidate and the walk reaches a healthy replica.
-    Only a genuinely-expired CALLER budget propagates."""
+def test_empty_walk_probe_timeout_never_undercuts_hang_floor():
+    """Lowering _EMPTY_PROBE_TIMEOUT_S below the hang floor must not
+    disable empty-walk ejection: the effective probe timeout derives
+    as max(constant, floor), so hung candidates still classify as
+    hangs, eject, and the walk reaches a healthy replica.  Only a
+    genuinely-expired CALLER budget propagates."""
     from ratelimit_tpu.cluster.router import DeadlineExceededError
 
     class _Deadline(Exception):
@@ -746,18 +747,18 @@ def test_empty_walk_survives_sub_floor_probe_expiry():
         [hung(0), hung(1), healthy],
         eject_after=1,
     )
-    # Force every probe cap below the 5s hang floor: the exact
-    # ambiguity the walk's own classification must resolve.
+    # Maintainer lowers the constant below the 5s hang floor: the
+    # derived max() keeps full-length probes at the floor.
     r._EMPTY_PROBE_TIMEOUT_S = 0.5
+    assert r._probe_timeout_s() == 5.0
     try:
         req = rls_pb2.RateLimitRequest(domain="basic")  # no descriptors
         resp = r.should_rate_limit(req)  # no caller deadline
         assert resp.overall_code == rls_pb2.RateLimitResponse.OK
         assert seen[-1][0] == "ok"
-        # Sub-floor expiries prove nothing about replica health: no
-        # ejection (genuine hangs are recorded by _checked_call's
-        # hang-floor classification, not by this walk).
-        assert r.live_replica_count() == 3
+        # Full-length probe expiries still classify as hangs in
+        # _checked_call: both hung candidates ejected.
+        assert r.live_replica_count() == 1
     finally:
         r.close()
 
