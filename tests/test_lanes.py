@@ -198,3 +198,38 @@ def test_topology_change_refuses_cross_role_restore(cfg, tmp_path):
     mgr2 = CheckpointManager(cache2, str(tmp_path), interval_s=3600)
     assert mgr2.restore() == 0  # lane0of2 != lane0of1, lane1of2 != per_second
     assert len(cache2.per_second_engine.slot_table) == 0
+
+def test_lanes_compose_with_sharded_engines(cfg):
+    """Matrix cell: TPU_NUM_LANES x tpu-sharded — each lane is its own
+    bank-sharded engine over the virtual mesh; counting stays exact
+    through the lane split AND the bank split."""
+    from ratelimit_tpu.runner import create_limiter
+    from ratelimit_tpu.settings import Settings
+
+    s = Settings(
+        backend_type="tpu-sharded",
+        tpu_num_lanes=2,
+        tpu_num_slots=1 << 9,
+        tpu_batch_window_us=0,
+        tpu_batch_buckets=[8, 32],
+        use_statsd=False,
+    )
+    clock = PinnedTimeSource(1_000_000)
+    cache = create_limiter(s, Manager(), None, clock)
+    from ratelimit_tpu.parallel import ShardedCounterEngine
+
+    assert len(cache.lanes) == 2
+    assert all(isinstance(e, ShardedCounterEngine) for e in cache.lanes)
+    req = _req([f"sl{i}" for i in range(16)] + ["sl0"])  # dup key too
+    rules = _rules(cfg, req)
+    sts = cache.do_limit(req, rules)
+    assert all(st.code == Code.OK for st in sts)
+    # 5/min: sl0 was hit twice above; three more OKs then OVER.
+    one = _req(["sl0"])
+    r1 = _rules(cfg, one)
+    codes = [cache.do_limit(one, r1)[0].code for _ in range(4)]
+    assert codes == [Code.OK] * 3 + [Code.OVER_LIMIT]
+    total = sum(int(e.export_counts().sum()) for e in cache.lanes)
+    # 15 other keys x1, sl0 = 2 (first request incl. dup) + 4 more
+    # (the OVER call still increments: reference INCRBY-then-compare).
+    assert total == 15 + 6  # every hit counted exactly once
