@@ -71,6 +71,18 @@ def create_limiter(s: Settings, stats_manager: Manager, local_cache, time_source
             cache_key_prefix=s.cache_key_prefix,
             expiration_jitter_max_seconds=s.expiration_jitter_max_seconds,
         )
+    if backend in ("tpu-write-behind", "tpu-sharded-write-behind") and int(
+        s.tpu_num_lanes
+    ) > 1:
+        # Lanes exist only for the sync tpu backends (the write-behind
+        # path decides on the host view; its dispatcher never gates
+        # request latency).  A silently-ignored knob reads as "on".
+        logger.warning(
+            "TPU_NUM_LANES=%s is ignored by backend %r (lanes apply to "
+            "tpu / tpu-sharded)",
+            s.tpu_num_lanes,
+            s.backend_type,
+        )
     if backend in ("tpu-write-behind", "tpu-sharded-write-behind"):
         # Memcached-mode analog: decide on host, commit async
         # (reference memcached/cache_impl.go:58-174; see

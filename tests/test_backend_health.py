@@ -197,3 +197,41 @@ def test_health_requires_every_dispatcher_healthy():
         assert health.calls.count("ok") == 1
     finally:
         cache.close()
+
+def test_one_dead_lane_flips_process_not_serving():
+    """r5 lanes: every lane's dispatcher reports into the aggregated
+    health — one dead lane must flip the process NOT_SERVING even
+    while the other lanes keep serving their partitions."""
+    import time as _t
+
+    from ratelimit_tpu.backends.tpu_cache import TpuRateLimitCache
+
+    class _FakeHealth:
+        def __init__(self):
+            self.calls = []
+
+        def ok(self):
+            self.calls.append("ok")
+
+        def fail(self):
+            self.calls.append("fail")
+
+    lanes = [CounterEngine(num_slots=256, buckets=(8,)) for _ in range(3)]
+    cache = TpuRateLimitCache(lanes, batch_window_us=100)
+    try:
+        h = _FakeHealth()
+        cache.bind_health(h)
+        assert len(cache._dispatchers) == 3
+        victim = cache._dispatchers[id(lanes[1])]
+        with victim._buf_cv:  # poison entry kills the collector
+            victim._buf.append(object())
+            victim._buf_cv.notify()
+        deadline = _t.monotonic() + 5
+        while victim.dead is None and _t.monotonic() < deadline:
+            _t.sleep(0.01)
+        deadline = _t.monotonic() + 5
+        while not h.calls and _t.monotonic() < deadline:
+            _t.sleep(0.01)
+        assert h.calls and h.calls[-1] == "fail"
+    finally:
+        cache.close()
