@@ -233,3 +233,59 @@ def test_lanes_compose_with_sharded_engines(cfg):
     # 15 other keys x1, sl0 = 2 (first request incl. dup) + 4 more
     # (the OVER call still increments: reference INCRBY-then-compare).
     assert total == 15 + 6  # every hit counted exactly once
+
+def test_lanes_serve_over_the_wire_batched(tmp_path):
+    """The strongest lane cell: a full Runner with TPU_NUM_LANES=2 and
+    the batching dispatcher ON serves wire-exact progression over real
+    gRPC, with both lanes live."""
+    import grpc
+
+    from ratelimit_tpu.runner import Runner
+    from ratelimit_tpu.settings import Settings
+
+    from ratelimit_tpu.server import pb  # noqa: F401
+    from envoy.service.ratelimit.v3 import rls_pb2
+
+    config_dir = tmp_path / "ratelimit" / "config"
+    config_dir.mkdir(parents=True)
+    (config_dir / "lanes.yaml").write_text(YAML)
+    r = Runner(
+        Settings(
+            host="127.0.0.1", port=0, grpc_host="127.0.0.1", grpc_port=0,
+            debug_host="127.0.0.1", debug_port=0, use_statsd=False,
+            backend_type="tpu", tpu_num_lanes=2, tpu_num_slots=1 << 10,
+            tpu_batch_window_us=200, tpu_batch_buckets=[8, 32],
+            runtime_path=str(tmp_path), runtime_subdirectory="ratelimit",
+            local_cache_size_in_bytes=0, expiration_jitter_max_seconds=0,
+        ),
+        time_source=PinnedTimeSource(1_000_000),
+    )
+    r.start()
+    try:
+        assert len(r.cache.lanes) == 2
+        addr = f"127.0.0.1:{r.grpc_server.bound_port}"
+        with grpc.insecure_channel(addr) as ch:
+            method = ch.unary_unary(
+                "/envoy.service.ratelimit.v3.RateLimitService/"
+                "ShouldRateLimit",
+                request_serializer=rls_pb2.RateLimitRequest.SerializeToString,
+                response_deserializer=rls_pb2.RateLimitResponse.FromString,
+            )
+            OK = rls_pb2.RateLimitResponse.OK
+            OVER = rls_pb2.RateLimitResponse.OVER_LIMIT
+            # Spread keys until both lanes hold state.
+            for i in range(16):
+                q = rls_pb2.RateLimitRequest(domain="lanes")
+                e = q.descriptors.add().entries.add()
+                e.key, e.value = "key1", f"w{i}"
+                assert method(q, timeout=30).overall_code == OK
+            r.cache.flush()
+            assert all(len(e.slot_table) > 0 for e in r.cache.lanes)
+            # Wire-exact 5/min progression on one key.
+            q = rls_pb2.RateLimitRequest(domain="lanes")
+            e = q.descriptors.add().entries.add()
+            e.key, e.value = "key1", "w0"  # already at 1
+            codes = [method(q, timeout=30).overall_code for _ in range(5)]
+            assert codes == [OK] * 4 + [OVER]
+    finally:
+        r.stop()
