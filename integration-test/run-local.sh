@@ -4,9 +4,11 @@
 # and runs every scenario in scripts-local/ against live surfaces.
 # 01-03 are the compose stack's scenarios (run-all.sh: happy path, 429
 # after quota, shadow mode never blocks) minus the Envoy hop (no envoy
-# binary here); 04 (checkpoint/restart survival) and 05 (multi-replica
-# joint enforcement through the cluster proxy) are local-only and
-# launch their own server processes.
+# binary here); 04-08 are local-only and launch their own server
+# processes: 04 checkpoint/restart + kill-9 recovery, 05 multi-replica
+# cluster (joint enforcement, live membership, SIGKILL failover),
+# 06 sharded backend, 07 TLS+auth cluster hop, 08 host lanes +
+# per-lane checkpoint recovery.
 #
 # Usage:  sh integration-test/run-local.sh     (or `make e2e-local`,
 # which records the transcript in integration-test/results/).
