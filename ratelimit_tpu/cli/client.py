@@ -42,20 +42,52 @@ def main(argv=None) -> int:
         help="descriptor list: k=v,k2=v2 (one descriptor)",
     )
     p.add_argument("--hits-addend", type=int, default=0)
+    p.add_argument(
+        "--tls-ca", default="",
+        help="PEM CA verifying the server cert; enables TLS "
+        "(servers with GRPC_SERVER_TLS_CERT set)",
+    )
+    p.add_argument(
+        "--tls-cert", default="",
+        help="PEM client certificate for mTLS servers",
+    )
+    p.add_argument("--tls-key", default="", help="key for --tls-cert")
+    p.add_argument(
+        "--auth-token", default="",
+        help="bearer token for servers with GRPC_AUTH_TOKEN set",
+    )
     args = p.parse_args(argv)
+    if bool(args.tls_cert) != bool(args.tls_key):
+        p.error("--tls-cert and --tls-key must be given together")
 
     request = parse_descriptors(args.descriptors)
     request.domain = args.domain
     request.hits_addend = args.hits_addend
 
-    with grpc.insecure_channel(args.dial_string) as channel:
+    if args.tls_ca:
+        from ..cluster.proxy import replica_channel_credentials
+
+        channel = grpc.secure_channel(
+            args.dial_string,
+            replica_channel_credentials(
+                args.tls_ca, args.tls_cert, args.tls_key
+            ),
+        )
+    else:
+        channel = grpc.insecure_channel(args.dial_string)
+    metadata = (
+        (("authorization", f"Bearer {args.auth_token}"),)
+        if args.auth_token
+        else None
+    )
+    with channel:
         method = channel.unary_unary(
             "/envoy.service.ratelimit.v3.RateLimitService/ShouldRateLimit",
             request_serializer=rls_pb2.RateLimitRequest.SerializeToString,
             response_deserializer=rls_pb2.RateLimitResponse.FromString,
         )
         try:
-            response = method(request, timeout=10)
+            response = method(request, timeout=10, metadata=metadata)
         except grpc.RpcError as e:
             print(f"error: {e.code().name}: {e.details()}", file=sys.stderr)
             return 1
