@@ -18,7 +18,6 @@ from __future__ import annotations
 import threading
 import time
 from collections import OrderedDict
-from typing import Optional
 
 from ..stats.manager import StatsStore
 
