@@ -55,8 +55,42 @@ LIMIT_MAX = 1000
 
 
 def main() -> None:
+    # Device-discovery watchdog: with the axon tunnel down,
+    # jax.devices() HANGS instead of erroring — a hung bench is worse
+    # than a failed one (the driver can at least record a failure
+    # line).  Disarmed the moment discovery returns.
+    import os
+    import threading
+
+    armed = threading.Event()
+    armed.set()
+
+    def watchdog():
+        import time as _t
+
+        _t.sleep(180)
+        if armed.is_set():
+            print(
+                json.dumps(
+                    {
+                        "metric": "fixed_window_decisions_per_sec",
+                        "value": 0,
+                        "unit": "decisions/s/chip",
+                        "vs_baseline": 0,
+                        "error": "device discovery hung >180s (tunnel down?)",
+                    }
+                ),
+                flush=True,
+            )
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+
     import jax
     import jax.numpy as jnp
+
+    jax.devices()  # force discovery under the watchdog
+    armed.clear()
 
     from ratelimit_tpu.models.fixed_window import DeviceBatch, FixedWindowModel
 
