@@ -277,6 +277,7 @@ class Runner:
             port=s.grpc_port,
             max_connection_age_s=s.grpc_max_connection_age,
             max_connection_age_grace_s=s.grpc_max_connection_age_grace,
+            max_workers=s.grpc_max_workers,
             credentials=credentials,
             auth_token=s.grpc_auth_token,
         )

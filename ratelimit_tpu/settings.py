@@ -94,6 +94,11 @@ class Settings:
     # gRPC keepalive (settings.go:25-27); seconds.
     grpc_max_connection_age: float = 24 * 3600.0
     grpc_max_connection_age_grace: float = 3600.0
+    # RPC handler thread pool size (the goroutine-per-RPC analog is a
+    # bounded pool here).  Size it ~2x concurrent in-flight RPCs; each
+    # waiting handler parks on an event, so threads are cheap but not
+    # free (GIL wakeups).
+    grpc_max_workers: int = 32
 
     # Transport security + auth for the serving surface — the analog
     # of the reference's Redis TLS + AUTH knobs (settings.go:62-92,
@@ -244,6 +249,7 @@ def new_settings() -> Settings:
             "LIMIT_REMAINING_HEADER", "RateLimit-Remaining"
         ),
         header_ratelimit_reset=_env_str("LIMIT_RESET_HEADER", "RateLimit-Reset"),
+        grpc_max_workers=_env_int("GRPC_MAX_WORKERS", 32),
         grpc_server_tls_cert=_env_str("GRPC_SERVER_TLS_CERT", ""),
         grpc_server_tls_key=_env_str("GRPC_SERVER_TLS_KEY", ""),
         grpc_server_tls_ca=_env_str("GRPC_SERVER_TLS_CA", ""),
