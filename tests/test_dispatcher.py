@@ -204,3 +204,67 @@ def test_collector_runs_periodic_gc(clock):
         assert len(engine.slot_table) == 1  # old_0 reclaimed, new_0 lives
     finally:
         d.stop()
+
+def test_eager_idle_launches_lone_item_but_coalesces_under_load():
+    """r5 eager-idle: a lone arrival at a fully idle dispatcher
+    launches without waiting the window; items arriving while a batch
+    is IN FLIGHT still coalesce (the window discipline under load is
+    unchanged).  Deterministic via an engine whose completion blocks
+    until released."""
+    release = threading.Event()
+    batches = []
+
+    class _GatedEngine(CounterEngine):
+        def step_complete(self, token):
+            release.wait(10)
+            return super().step_complete(token)
+
+        def submit_packed(self, now, blob, meta):
+            batches.append(len(meta))
+            return super().submit_packed(now, blob, meta)
+
+    engine = _GatedEngine(num_slots=256, buckets=(8, 32))
+    # Generous window: only eager-idle could launch item A quickly.
+    d = BatchDispatcher(engine, batch_window_us=150_000, batch_limit=4096)
+    try:
+        def item(name):
+            return WorkItem(
+                now=0,
+                lanes=[Lane(key=f"{name}_0", expiry=60, limit=10,
+                            shadow=False, hits=1)],
+                apply=lambda dec: None,
+            )
+
+        release.set()  # first launches complete immediately
+        warm = item("warm")  # pay the first-shape XLA compile untimed
+        d.submit(warm)
+        warm.wait(30)
+        batches.clear()
+
+        a = item("a")
+        t0 = time.monotonic()
+        d.submit(a)
+        a.wait(5)
+        assert time.monotonic() - t0 < 0.05  # no 150ms window wait
+        assert batches == [1]
+
+        # Hold the NEXT completion: while it is in flight, b and c
+        # must coalesce instead of each launching eagerly.
+        release.clear()
+        d.submit(item("hold"))  # eager (idle again) -> in flight, held
+        deadline = time.monotonic() + 5
+        while len(batches) < 2 and time.monotonic() < deadline:
+            time.sleep(0.001)
+        assert batches == [1, 1]
+        b, c = item("b"), item("c")
+        d.submit(b)
+        d.submit(c)
+        time.sleep(0.05)  # well under the 150ms window
+        assert len(batches) == 2  # nothing launched while held
+        release.set()
+        b.wait(5)
+        c.wait(5)
+        assert batches == [1, 1, 2]  # b+c rode ONE coalesced batch
+    finally:
+        release.set()
+        d.stop()
