@@ -18,6 +18,7 @@ import argparse
 import logging
 import signal
 import threading
+import time
 from concurrent import futures
 from typing import List, Optional
 
@@ -381,18 +382,47 @@ def make_server(
     )
     from grpchealth.v1 import health_pb2  # noqa: PLC0415
 
-    def health_check(request_pb, context):
+    def health_status():
         # Both accepted shapes (RouterHolder in prod, a bare
         # ReplicaRouter in tests) implement any_live(); anything else
         # fails loudly rather than defaulting to SERVING.
-        alive = router.any_live()
-        return health_pb2.HealthCheckResponse(
-            status=(
-                health_pb2.HealthCheckResponse.SERVING
-                if alive
-                else health_pb2.HealthCheckResponse.NOT_SERVING
-            )
+        return (
+            health_pb2.HealthCheckResponse.SERVING
+            if router.any_live()
+            else health_pb2.HealthCheckResponse.NOT_SERVING
         )
+
+    def health_check(request_pb, context):
+        return health_pb2.HealthCheckResponse(status=health_status())
+
+    # Each Watch stream parks a sync-server worker thread for its
+    # lifetime; cap them so probes can never starve ShouldRateLimit
+    # (same discipline as the replica server's MAX_WATCH_STREAMS,
+    # server/grpc_server.py).
+    watch_slots = threading.BoundedSemaphore(4)
+
+    def health_watch(request_pb, context):
+        # Streaming Watch, like the replicas serve: the proxy has no
+        # push-based health source (liveness is derived from the
+        # router's circuits), so the stream polls and yields only on
+        # CHANGE — the first response is immediate per the health/v1
+        # contract.
+        if not watch_slots.acquire(blocking=False):
+            context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                "too many health watch streams (max 4)",
+            )
+        try:
+            last = health_status()
+            yield health_pb2.HealthCheckResponse(status=last)
+            while context.is_active():
+                time.sleep(1.0)
+                now = health_status()
+                if now != last:
+                    last = now
+                    yield health_pb2.HealthCheckResponse(status=now)
+        finally:
+            watch_slots.release()
 
     health_handler = grpc.method_handlers_generic_handler(
         "grpc.health.v1.Health",
@@ -403,7 +433,14 @@ def make_server(
                 response_serializer=(
                     health_pb2.HealthCheckResponse.SerializeToString
                 ),
-            )
+            ),
+            "Watch": grpc.unary_stream_rpc_method_handler(
+                health_watch,
+                request_deserializer=health_pb2.HealthCheckRequest.FromString,
+                response_serializer=(
+                    health_pb2.HealthCheckResponse.SerializeToString
+                ),
+            ),
         },
     )
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))

@@ -441,3 +441,62 @@ def test_srv_initial_resolution_retries_until_populated():
             "_rl._tcp.x", retry_s=0.01,
             resolve=lambda r: [], stop=stop,
         )
+
+def test_proxy_health_watch_streams_transitions():
+    """The proxy serves grpc.health.v1 Watch like the replicas do:
+    first response immediately, then a NOT_SERVING update when every
+    replica's circuit opens."""
+    import threading
+    import time as _t
+
+    from grpchealth.v1 import health_pb2
+
+    from ratelimit_tpu.cluster.proxy import make_server
+    from ratelimit_tpu.cluster.router import ReplicaRouter
+
+    def dead(req, timeout_s=None):
+        raise ConnectionError("down")
+
+    router = ReplicaRouter(["r0:1"], [dead], eject_after=1)
+    server, port = make_server(router, "127.0.0.1", 0)
+    server.start()
+    try:
+        got = []
+        done = threading.Event()
+
+        def watcher():
+            with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+                watch = ch.unary_stream(
+                    "/grpc.health.v1.Health/Watch",
+                    request_serializer=(
+                        health_pb2.HealthCheckRequest.SerializeToString
+                    ),
+                    response_deserializer=(
+                        health_pb2.HealthCheckResponse.FromString
+                    ),
+                )
+                for resp in watch(
+                    health_pb2.HealthCheckRequest(), timeout=15
+                ):
+                    got.append(resp.status)
+                    if len(got) >= 2:
+                        done.set()
+                        return
+
+        t = threading.Thread(target=watcher, daemon=True)
+        t.start()
+        deadline = _t.monotonic() + 5
+        while not got and _t.monotonic() < deadline:
+            _t.sleep(0.02)
+        assert got[:1] == [health_pb2.HealthCheckResponse.SERVING]
+        # Kill the only replica through the serving path: ejected ->
+        # the watch stream must push NOT_SERVING.
+        req = rls_pb2.RateLimitRequest(domain="px")
+        e = req.descriptors.add().entries.add()
+        e.key, e.value = "limited", "watch"
+        router.should_rate_limit(req)
+        assert done.wait(10)
+        assert got[-1] == health_pb2.HealthCheckResponse.NOT_SERVING
+    finally:
+        server.stop(grace=None)
+        router.close()

@@ -245,7 +245,9 @@ def test_eager_idle_launches_lone_item_but_coalesces_under_load():
         t0 = time.monotonic()
         d.submit(a)
         a.wait(5)
-        assert time.monotonic() - t0 < 0.05  # no 150ms window wait
+        # Loose bound: well under the 150ms window proves the eager
+        # launch fired; tight real-time bounds flake on loaded CI.
+        assert time.monotonic() - t0 < 0.1
         assert batches == [1]
 
         # Hold the NEXT completion: while it is in flight, b and c
