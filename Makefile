@@ -62,8 +62,9 @@ e2e-local:
 	cat integration-test/results/local-e2e.txt
 
 # The full CI recipe (.github/workflows/ci.yaml runs exactly this):
-# native build, tests, black-box e2e, bench smoke on the CPU platform.
-ci: native test e2e-local
+# native build, tests, offline config validation, black-box e2e,
+# bench smoke on the CPU platform.
+ci: native test check_config e2e-local
 	$(CPU_ENV) PALLAS_AXON_POOL_IPS= $(PY) bench.py
 
 clean:
