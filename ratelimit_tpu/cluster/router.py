@@ -488,11 +488,18 @@ class ReplicaRouter:
                 return sub_rows, None, e
 
         owners = list(by_owner.items())
-        futures = [
-            self._pool.submit(sub_call, owner, sub_rows)
-            for owner, sub_rows in owners[1:]
-        ]
+        futures = []
+        inline_extra = []
+        for owner, sub_rows in owners[1:]:
+            try:
+                futures.append(self._pool.submit(sub_call, owner, sub_rows))
+            except RuntimeError:
+                # Pool already retired (a request can outlive its
+                # router past the membership-swap grace): degrade to
+                # sequential sub-calls instead of erroring the RPC.
+                inline_extra.append((owner, sub_rows))
         results = [sub_call(*owners[0])]
+        results.extend(sub_call(o, r) for o, r in inline_extra)
         results.extend(f.result() for f in futures)
         return results
 

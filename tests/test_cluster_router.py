@@ -816,3 +816,30 @@ def test_clamped_probe_expiry_never_ejects_healthy_replica():
         assert _t.monotonic() - t0 < 2.0
     finally:
         r.close()
+
+def test_retired_pool_degrades_to_inline_fanout():
+    """A request that outlives its router past the membership-swap
+    grace (RouterHolder.swap closes the old pool) must still answer —
+    sub-calls run sequentially instead of erroring the RPC."""
+    OK = rls_pb2.RateLimitResponse.OK
+    r = ReplicaRouter(
+        ["a", "b"], [_fake_service(OK), _fake_service(OK)]
+    )
+    r._pool.shutdown(wait=False)  # the swap grace fired mid-request
+    try:
+        # Two descriptors owned by different replicas: the second
+        # owner's sub-call needs the (now retired) pool.
+        want = {0: None, 1: None}
+        i = 0
+        while None in want.values():
+            d = [("key1", f"rp{i}")]
+            owner = r.owner_for("basic", _request("basic", [d]).descriptors[0])
+            if want[owner] is None:
+                want[owner] = d
+            i += 1
+        req = _request("basic", [want[0], want[1]])
+        resp = r.should_rate_limit(req)
+        assert resp.overall_code == OK
+        assert len(resp.statuses) == 2
+    finally:
+        r.close()
