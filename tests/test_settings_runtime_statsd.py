@@ -107,3 +107,27 @@ def test_statsd_exporter_flush():
     assert "ratelimit.service.x" not in payload
     assert "ratelimit.g:7|g" in payload
     recv.close()
+
+def test_round5_env_knobs_parse(monkeypatch):
+    """Round-5 env names are locked: lanes, worker pool, TLS/auth,
+    gc tuning all round-trip through new_settings()."""
+    from ratelimit_tpu.settings import new_settings
+
+    for k, v in {
+        "TPU_NUM_LANES": "4",
+        "GRPC_MAX_WORKERS": "64",
+        "GRPC_AUTH_TOKEN": "tok",
+        "GRPC_SERVER_TLS_CERT": "/c",
+        "GRPC_SERVER_TLS_KEY": "/k",
+        "GRPC_SERVER_TLS_CA": "/ca",
+        "GC_TUNING": "false",
+    }.items():
+        monkeypatch.setenv(k, v)
+    s = new_settings()
+    assert s.tpu_num_lanes == 4
+    assert s.grpc_max_workers == 64
+    assert s.grpc_auth_token == "tok"
+    assert s.grpc_server_tls_cert == "/c"
+    assert s.grpc_server_tls_key == "/k"
+    assert s.grpc_server_tls_ca == "/ca"
+    assert s.gc_tuning is False
