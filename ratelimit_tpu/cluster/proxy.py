@@ -338,6 +338,39 @@ def watch_replicas_srv(
     return t, stop
 
 
+def start_debug_server(holder, host: str, port: int):
+    """Optional HTTP observability for the proxy (the replicas'
+    debug-port analog): /stats.json returns the router's failover
+    counters + live membership; /healthcheck mirrors the gRPC health
+    probe (200 while any replica is live, 500 otherwise)."""
+    import json as _json
+
+    from ..server.http_server import HttpServer
+
+    srv = HttpServer(host, port, name="proxy-debug")
+
+    def stats_json(h):
+        h._reply(
+            200,
+            _json.dumps(
+                {"replica_ids": list(holder.replica_ids), **holder.stats()}
+            ).encode(),
+            content_type="application/json",
+        )
+
+    def healthcheck(h):
+        if holder.any_live():
+            h._reply(200, b"OK")
+        else:
+            h._reply(500, b"NOT_SERVING")
+
+    srv.add_route("GET", "/stats.json", stats_json)
+    srv.add_route("GET", "/healthcheck", healthcheck)
+    srv.start()
+    logger.warning("proxy debug listener on :%d", srv.bound_port)
+    return srv
+
+
 def make_server(
     router: ReplicaRouter, host: str, port: int, credentials=None
 ):
@@ -478,6 +511,12 @@ def main(argv=None) -> None:
     )
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8082)
+    p.add_argument(
+        "--debug-port", type=int, default=0,
+        help="optional HTTP debug listener: /stats.json (failover "
+        "counters + live membership, the replicas' debug-port analog) "
+        "and /healthcheck; 0 disables",
+    )
     p.add_argument("--poll-seconds", type=float, default=2.0)
     p.add_argument(
         "--srv-refresh-seconds", type=float, default=10.0,
@@ -586,6 +625,11 @@ def main(argv=None) -> None:
         own_creds = server_credentials(args.tls_cert, args.tls_key)
     server, bound = make_server(holder, args.host, args.port, own_creds)
     server.start()
+    debug_server = None
+    if args.debug_port:
+        debug_server = start_debug_server(
+            holder, args.host, args.debug_port
+        )
     logger.warning(
         "cluster proxy serving :%d over %d replicas", bound, len(addrs)
     )
@@ -608,6 +652,8 @@ def main(argv=None) -> None:
         signal.signal(sig, lambda *_: stop.set())
     stop.wait()
     server.stop(grace=5).wait()
+    if debug_server is not None:
+        debug_server.stop()
     holder.close()
 
 

@@ -500,3 +500,49 @@ def test_proxy_health_watch_streams_transitions():
     finally:
         server.stop(grace=None)
         router.close()
+
+def test_proxy_debug_listener_serves_stats_and_health():
+    """--debug-port analog: /stats.json returns failover counters +
+    membership; /healthcheck mirrors replica liveness."""
+    import json as _json
+    import urllib.request
+
+    from ratelimit_tpu.cluster.proxy import (
+        RouterHolder,
+        start_debug_server,
+    )
+    from ratelimit_tpu.cluster.router import ReplicaRouter
+
+    def dead(req, timeout_s=None):
+        raise ConnectionError("down")
+
+    holder = RouterHolder(ReplicaRouter(["r0:1"], [dead], eject_after=1))
+    srv = start_debug_server(holder, "127.0.0.1", 0)
+    try:
+        base = f"http://127.0.0.1:{srv.bound_port}"
+        snap = _json.loads(
+            urllib.request.urlopen(base + "/stats.json", timeout=5).read()
+        )
+        assert snap["replica_ids"] == ["r0:1"]
+        assert snap["live_replicas"] == 1
+        assert urllib.request.urlopen(
+            base + "/healthcheck", timeout=5
+        ).status == 200
+
+        # Eject the only replica through the serving path.
+        req = rls_pb2.RateLimitRequest(domain="px")
+        e = req.descriptors.add().entries.add()
+        e.key, e.value = "limited", "dbg"
+        holder.should_rate_limit(req)
+        snap = _json.loads(
+            urllib.request.urlopen(base + "/stats.json", timeout=5).read()
+        )
+        assert snap["live_replicas"] == 0 and snap["ejections"] == 1
+        try:
+            urllib.request.urlopen(base + "/healthcheck", timeout=5)
+            raise AssertionError("healthcheck should be 500")
+        except urllib.error.HTTPError as err:
+            assert err.code == 500
+    finally:
+        srv.stop()
+        holder.close()
