@@ -118,7 +118,7 @@ done
 printf '127.0.0.1:19081\n127.0.0.1:29081\n' > "$RL/replicas.txt"
 "${PY:-python}" -m ratelimit_tpu.cluster.proxy \
   --replicas-file "$RL/replicas.txt" --poll-seconds 0.5 \
-  --host 127.0.0.1 --port 29090 >"$RL/proxy2.log" 2>&1 &
+  --host 127.0.0.1 --port 29090 --debug-port 29091 >"$RL/proxy2.log" 2>&1 &
 PIDS="$PIDS $!"
 for i in $(seq 1 30); do
   "${PY:-python}" -c "import socket,sys; s=socket.socket(); s.settimeout(0.5); sys.exit(0 if s.connect_ex(('127.0.0.1',29090))==0 else 1)" && break
@@ -198,3 +198,12 @@ for i in $(seq 1 10); do
 done
 [ "$ejected" = "1" ] || { echo "dead replica never ejected"; tail -8 "$RL/proxy2.log"; exit 1; }
 echo ok-failover
+
+# The proxy's debug listener reflects the failover: ejections counted,
+# live membership shrunk to 2 of 3.
+snap=$(curl -s http://127.0.0.1:29091/stats.json)
+echo "$snap" | grep -q '"ejections": 1' || { echo "debug stats missing ejection: $snap"; exit 1; }
+echo "$snap" | grep -q '"live_replicas": 2' || { echo "debug stats wrong liveness: $snap"; exit 1; }
+curl -s -o /dev/null -w "%{http_code}" http://127.0.0.1:29091/healthcheck | grep -q 200 \
+  || { echo "proxy debug healthcheck not 200"; exit 1; }
+echo ok-debug-port
