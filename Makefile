@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: all test bench protos native serve check_config smoke_client docker_image e2e e2e-local ci clean
+.PHONY: all test lint bench protos native serve check_config smoke_client docker_image e2e e2e-local ci clean
 
 # C++ hot-path library: slot table + decide kernel (auto-built on
 # first import too; this forces it).  Goes through the Python builder
@@ -20,6 +20,12 @@ all: test
 # CPU mesh; no TPU needed).
 test:
 	$(PY) -m pytest tests/ -q
+
+# tpu-lint static analysis (jax-host-sync, lock-discipline,
+# env-discipline, dtype-discipline — docs/STATIC_ANALYSIS.md).
+# Fails on any unsuppressed finding; pure stdlib, no jax needed.
+lint:
+	PY=$(PY) sh scripts/lint.sh
 
 # Headline benchmark on the default JAX device (real chip under axon).
 bench:
@@ -64,7 +70,7 @@ e2e-local:
 # The full CI recipe (.github/workflows/ci.yaml runs exactly this):
 # native build, tests, offline config validation, black-box e2e,
 # bench smoke on the CPU platform.
-ci: native test check_config e2e-local
+ci: lint native test check_config e2e-local
 	$(CPU_ENV) PALLAS_AXON_POOL_IPS= $(PY) bench.py
 
 clean:

@@ -1,0 +1,25 @@
+"""tpu-lint: project-native static analysis for JAX tracing hygiene
+and threaded-backend lock discipline.
+
+The reference Go repo leans on ``go vet`` and the race detector; this
+Python/JAX port gets neither, so the two bug classes that silently
+kill a production limiter — host syncs sneaking into jit'd hot paths
+and data races in the threaded backends — are caught here as AST
+checks instead (docs/STATIC_ANALYSIS.md).
+
+Usage:
+    python -m ratelimit_tpu.analysis [paths...]
+
+Pure stdlib (ast + tokenize): importable and runnable on machines
+without jax/grpc installed, so CI can gate on it before any heavy
+dependency resolves.
+"""
+
+from .engine import (  # noqa: F401
+    AnalysisEngine,
+    FileContext,
+    Finding,
+    Rule,
+    run_paths,
+)
+from .rules import DEFAULT_RULES  # noqa: F401

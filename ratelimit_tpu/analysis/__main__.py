@@ -1,0 +1,71 @@
+"""CLI: ``python -m ratelimit_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean, 1 unsuppressed findings, 2 usage error — so
+``make lint`` / scripts/lint.sh gate directly on the return status.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .engine import run_paths
+from .rules import DEFAULT_RULES
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m ratelimit_tpu.analysis",
+        description=(
+            "tpu-lint: JAX tracing hygiene + lock discipline checks "
+            "(docs/STATIC_ANALYSIS.md)"
+        ),
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        default=["ratelimit_tpu"],
+        help="files or directories to lint (default: ratelimit_tpu)",
+    )
+    p.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="report format (default: text)",
+    )
+    p.add_argument(
+        "--select",
+        default="",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    p.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule pack and exit",
+    )
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rule in DEFAULT_RULES:
+            print(f"{rule.id}: {rule.description}")
+        return 0
+
+    rules = DEFAULT_RULES
+    if args.select:
+        wanted = {r.strip() for r in args.select.split(",") if r.strip()}
+        known = {r.id for r in rules}
+        unknown = wanted - known
+        if unknown:
+            print(
+                f"tpu-lint: unknown rule id(s): {sorted(unknown)} "
+                f"(known: {sorted(known)})",
+                file=sys.stderr,
+            )
+            return 2
+        rules = [r for r in rules if r.id in wanted]
+
+    return run_paths(args.paths, rules=rules, fmt=args.format)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

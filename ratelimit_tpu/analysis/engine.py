@@ -1,0 +1,294 @@
+"""AST-walking lint framework (the `go vet` analog for this repo).
+
+One engine walk per file: the engine parses the source, extracts
+``# tpu-lint: disable=...`` suppressions from the token stream, then
+performs a single parent-tracking AST walk, dispatching every node to
+each rule that declared interest in its type.  Rules accumulate
+findings; the engine filters suppressed ones and hands the rest to a
+text or JSON reporter.
+
+Rule protocol (subclass :class:`Rule`):
+
+- ``id`` / ``description``: stable rule identity (suppression key).
+- ``interests``: tuple of ``ast.AST`` subclasses the rule wants
+  dispatched (empty tuple = every node).
+- ``begin_file(ctx)``: per-file setup (pre-passes over ``ctx.tree``).
+- ``visit(node, parents, ctx)``: called once per interesting node;
+  ``parents`` is the ancestor chain, outermost first.
+- ``end_file(ctx)``: whole-file checks after the walk.
+- ``report(...)``: record a finding (collected by the engine).
+
+Suppressions:
+
+- ``# tpu-lint: disable=rule-a,rule-b`` on the FINDING'S line (or the
+  line a multi-line statement starts on) suppresses those rules there.
+- ``# tpu-lint: disable-file=rule-a`` anywhere suppresses the rule for
+  the whole file.
+- ``all`` is accepted in either form.
+
+Every suppression should carry a justification in the same comment,
+e.g. ``# tpu-lint: disable=lock-discipline -- collector-owned``.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import re
+import sys
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple, Type
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpu-lint:\s*(disable|disable-file)\s*=\s*([A-Za-z0-9_,\- ]+)"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule_id: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def text(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule_id}: {self.message}"
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule_id,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
+
+@dataclass
+class FileContext:
+    """Everything a rule may want about the file under analysis."""
+
+    path: str
+    source: str
+    tree: ast.Module
+    # line number -> set of rule ids suppressed on that line
+    line_suppressions: Dict[int, Set[str]] = field(default_factory=dict)
+    # rule ids suppressed for the whole file
+    file_suppressions: Set[str] = field(default_factory=set)
+
+    def is_suppressed(self, rule_id: str, line: int) -> bool:
+        if {"all", rule_id} & self.file_suppressions:
+            return True
+        on_line = self.line_suppressions.get(line, ())
+        return "all" in on_line or rule_id in on_line
+
+
+class Rule:
+    """Base class for one lint check; see the module docstring for the
+    dispatch protocol."""
+
+    id: str = ""
+    description: str = ""
+    # AST node types this rule wants dispatched; () = all nodes.
+    interests: Tuple[Type[ast.AST], ...] = ()
+
+    def __init__(self) -> None:
+        self._findings: List[Finding] = []
+
+    # -- per-file lifecycle (engine-driven) ------------------------------
+
+    def begin_file(self, ctx: FileContext) -> None:  # pragma: no cover
+        pass
+
+    def visit(
+        self, node: ast.AST, parents: Sequence[ast.AST], ctx: FileContext
+    ) -> None:  # pragma: no cover
+        pass
+
+    def end_file(self, ctx: FileContext) -> None:  # pragma: no cover
+        pass
+
+    # -- finding sink ----------------------------------------------------
+
+    def report(
+        self, ctx: FileContext, node: ast.AST, message: str
+    ) -> None:
+        self._findings.append(
+            Finding(
+                rule_id=self.id,
+                path=ctx.path,
+                line=getattr(node, "lineno", 1),
+                col=getattr(node, "col_offset", 0),
+                message=message,
+            )
+        )
+
+    def take_findings(self) -> List[Finding]:
+        out, self._findings = self._findings, []
+        return out
+
+
+def _extract_suppressions(
+    source: str,
+) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """Parse tpu-lint suppression comments from the token stream (not
+    a line regex: a '# tpu-lint:' inside a string literal must not
+    suppress anything)."""
+    per_line: Dict[int, Set[str]] = {}
+    whole_file: Set[str] = set()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            kind, raw = m.group(1), m.group(2)
+            # The rule list ends at a '--' justification separator;
+            # within each comma-separated piece only the first word is
+            # the rule id (anything after is commentary).
+            raw = raw.split("--", 1)[0]
+            rules = {
+                piece.split()[0]
+                for piece in raw.split(",")
+                if piece.split()
+            }
+            if kind == "disable-file":
+                whole_file |= rules
+            else:
+                per_line.setdefault(tok.start[0], set()).update(rules)
+    except tokenize.TokenError:
+        pass  # syntax trouble surfaces via ast.parse instead
+    return per_line, whole_file
+
+
+class AnalysisEngine:
+    """Run a rule pack over files; collect unsuppressed findings."""
+
+    def __init__(self, rules: Sequence[Rule]):
+        self.rules = list(rules)
+        ids = [r.id for r in self.rules]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate rule ids: {ids}")
+
+    def check_source(self, path: str, source: str) -> List[Finding]:
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            return [
+                Finding(
+                    rule_id="parse-error",
+                    path=path,
+                    line=e.lineno or 1,
+                    col=e.offset or 0,
+                    message=f"could not parse: {e.msg}",
+                )
+            ]
+        per_line, whole_file = _extract_suppressions(source)
+        ctx = FileContext(
+            path=path,
+            source=source,
+            tree=tree,
+            line_suppressions=per_line,
+            file_suppressions=whole_file,
+        )
+
+        for rule in self.rules:
+            rule.begin_file(ctx)
+
+        # Single parent-tracking walk, dispatching to interested rules.
+        by_interest: List[Tuple[Rule, Tuple[Type[ast.AST], ...]]] = [
+            (r, r.interests) for r in self.rules
+        ]
+        parents: List[ast.AST] = []
+
+        def walk(node: ast.AST) -> None:
+            for rule, interests in by_interest:
+                if not interests or isinstance(node, interests):
+                    rule.visit(node, parents, ctx)
+            parents.append(node)
+            for child in ast.iter_child_nodes(node):
+                walk(child)
+            parents.pop()
+
+        walk(tree)
+
+        findings: List[Finding] = []
+        for rule in self.rules:
+            rule.end_file(ctx)
+            findings.extend(rule.take_findings())
+
+        kept = [
+            f for f in findings if not ctx.is_suppressed(f.rule_id, f.line)
+        ]
+        kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
+        return kept
+
+    def check_file(self, path: str) -> List[Finding]:
+        source = Path(path).read_text(encoding="utf-8")
+        return self.check_source(str(path), source)
+
+
+def iter_python_files(paths: Iterable[str]) -> List[str]:
+    """Expand files/directories into a sorted .py file list; generated
+    protobuf modules (`*_pb2.py`) are mechanical output and skipped."""
+    out: List[str] = []
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            out.extend(
+                str(f)
+                for f in sorted(path.rglob("*.py"))
+                if not f.name.endswith("_pb2.py")
+            )
+        elif path.suffix == ".py":
+            out.append(str(path))
+    return out
+
+
+def run_paths(
+    paths: Sequence[str],
+    rules: Optional[Sequence[Rule]] = None,
+    fmt: str = "text",
+    out=None,
+) -> int:
+    """Lint `paths`; print findings in `fmt`; return the exit code
+    (0 = clean, 1 = findings, 2 = usage error)."""
+    from .rules import DEFAULT_RULES
+
+    out = out or sys.stdout
+    files = iter_python_files(paths)
+    if not files:
+        print(f"tpu-lint: no python files under {list(paths)}", file=sys.stderr)
+        return 2
+    engine = AnalysisEngine(rules if rules is not None else DEFAULT_RULES)
+    findings: List[Finding] = []
+    for f in files:
+        findings.extend(engine.check_file(f))
+
+    if fmt == "json":
+        json.dump(
+            {
+                "files_checked": len(files),
+                "count": len(findings),
+                "findings": [f.as_dict() for f in findings],
+            },
+            out,
+            indent=2,
+        )
+        out.write("\n")
+    else:
+        for f in findings:
+            print(f.text(), file=out)
+        print(
+            f"tpu-lint: {len(findings)} finding(s) in {len(files)} file(s)",
+            file=out,
+        )
+    return 1 if findings else 0

@@ -489,7 +489,10 @@ def make_server(
     return server, bound
 
 
-def main(argv=None) -> None:
+def build_arg_parser() -> argparse.ArgumentParser:
+    """The proxy's CLI surface (separate from main so tests can
+    assert flag defaults — e.g. the debug listener's loopback bind —
+    without starting servers)."""
     p = argparse.ArgumentParser(description=__doc__)
     g = p.add_mutually_exclusive_group(required=True)
     g.add_argument(
@@ -515,7 +518,15 @@ def main(argv=None) -> None:
         "--debug-port", type=int, default=0,
         help="optional HTTP debug listener: /stats.json (failover "
         "counters + live membership, the replicas' debug-port analog) "
-        "and /healthcheck; 0 disables",
+        "and /healthcheck; 0 disables.  UNAUTHENTICATED and without "
+        "TLS — keep it on a loopback/management interface "
+        "(--debug-host), never exposed to clients",
+    )
+    p.add_argument(
+        "--debug-host", default="127.0.0.1",
+        help="bind address for the debug listener (default loopback; "
+        "deliberately NOT --host, so the unauthenticated listener "
+        "never rides the serving interface to 0.0.0.0)",
     )
     p.add_argument("--poll-seconds", type=float, default=2.0)
     p.add_argument(
@@ -569,6 +580,11 @@ def main(argv=None) -> None:
         "--tls-key", default="",
         help="PEM key for --tls-cert",
     )
+    return p
+
+
+def main(argv=None) -> None:
+    p = build_arg_parser()
     args = p.parse_args(argv)
 
     # Half-configured cert/key pairs fail startup (silent plaintext or
@@ -628,7 +644,7 @@ def main(argv=None) -> None:
     debug_server = None
     if args.debug_port:
         debug_server = start_debug_server(
-            holder, args.host, args.debug_port
+            holder, args.debug_host, args.debug_port
         )
     logger.warning(
         "cluster proxy serving :%d over %d replicas", bound, len(addrs)

@@ -37,6 +37,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:
+    from jax import shard_map  # jax >= 0.5
+except ImportError:  # pre-promotion releases keep it experimental
+    from jax.experimental.shard_map import shard_map
+
 from ..backends.engine import CounterEngine
 from ..models.fixed_window import DeviceBatch, DeviceDecisions, decision_block
 from ..ops.prefix import per_slot_inclusive_prefix
@@ -85,7 +90,7 @@ class ShardedFixedWindowModel:
         counts_spec = NamedSharding(self.mesh, P(self.axis, None))
         repl = NamedSharding(self.mesh, P())
         return jax.jit(
-            jax.shard_map(
+            shard_map(
                 body,
                 mesh=self.mesh,
                 in_specs=(P(self.axis, None), P()),
@@ -164,7 +169,7 @@ class ShardedFixedWindowModel:
             counts_spec = NamedSharding(self.mesh, P(self.axis, None))
             routed = self._routed_batch_sharding
             fn = self._routed_fns[out_dtype] = jax.jit(
-                jax.shard_map(
+                shard_map(
                     body,
                     mesh=self.mesh,
                     in_specs=(P(self.axis, None), P(self.axis, None)),
@@ -209,7 +214,7 @@ class ShardedFixedWindowModel:
             packed_spec = NamedSharding(self.mesh, P(self.axis, None, None))
             out_routed = NamedSharding(self.mesh, P(self.axis, None))
             fn = self._routed_packed_fns[out_dtype] = jax.jit(
-                jax.shard_map(
+                shard_map(
                     body,
                     mesh=self.mesh,
                     in_specs=(P(self.axis, None), P(self.axis, None, None)),

@@ -58,6 +58,18 @@ def _make_engine(s: Settings, sharded: bool, num_slots: int):
     )
 
 
+def lane_slot_split(total_slots: int, n_lanes: int) -> list:
+    """Per-lane slot counts summing to `total_slots`: base = floor
+    division, with the remainder distributed one slot each to the
+    first lanes.  Every lane gets at least 1 slot (an empty engine
+    table cannot serve), so for the degenerate total < n_lanes the
+    sum exceeds the total rather than wedging a lane."""
+    base, rem = divmod(max(0, int(total_slots)), n_lanes)
+    return [
+        max(1, base + (1 if i < rem else 0)) for i in range(n_lanes)
+    ]
+
+
 def create_limiter(s: Settings, stats_manager: Manager, local_cache, time_source):
     """BackendType switch (reference runner.go:50-74)."""
     backend = s.backend_type.lower()
@@ -109,10 +121,13 @@ def create_limiter(s: Settings, stats_manager: Manager, local_cache, time_source
         sharded = backend == "tpu-sharded"
         n_lanes = max(1, int(s.tpu_num_lanes))
         # TPU_NUM_SLOTS is the total budget: each lane serves ~1/N of
-        # the hash-split keyspace from a 1/N-sized table.
-        per_lane_slots = max(1, s.tpu_num_slots // n_lanes)
+        # the hash-split keyspace from a ~1/N-sized table.  The
+        # division remainder goes to the first lanes so the per-lane
+        # sum equals the documented total (a floor division alone
+        # silently drops up to n_lanes-1 slots of capacity).
         engines = [
-            _make_engine(s, sharded, per_lane_slots) for _ in range(n_lanes)
+            _make_engine(s, sharded, per_lane)
+            for per_lane in lane_slot_split(s.tpu_num_slots, n_lanes)
         ]
         per_second_engine = (
             _make_engine(s, sharded, s.tpu_per_second_num_slots)

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import os
 import sys
+import tempfile
 import threading
 import time
 import traceback
@@ -114,8 +115,10 @@ def add_profiling_routes(
 ) -> None:
     """Mount /debug/threadz, /debug/profile, /debug/xla_trace (and a
     /debug/pprof/ index pointing at them)."""
+    # tempfile.gettempdir() honors TMPDIR without a direct env read
+    # (env-discipline: env vars become config in settings.py only).
     artifacts = artifacts_dir or os.path.join(
-        os.environ.get("TMPDIR", "/tmp"), "ratelimit_tpu_debug"
+        tempfile.gettempdir(), "ratelimit_tpu_debug"
     )
     trace_lock = threading.Lock()
 

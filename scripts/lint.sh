@@ -1,0 +1,8 @@
+#!/bin/sh
+# tpu-lint gate: fails on any unsuppressed finding in the package
+# tree (docs/STATIC_ANALYSIS.md).  Pure stdlib — safe to run before
+# heavy deps install.  PR gate: `make lint` runs exactly this.
+set -e
+cd "$(dirname "$0")/.."
+PY="${PY:-python}"
+exec "$PY" -m ratelimit_tpu.analysis ratelimit_tpu "$@"

@@ -1,0 +1,46 @@
+"""Near-miss patterns every rule must stay QUIET on (the false-
+positive guard half of the fixture suite)."""
+
+import functools
+import queue
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def static_branching(self, counts, out_dtype, batch):
+    """Branches on static args and jnp casts: all tracing-legal."""
+    if out_dtype:  # clean: static_argnums covers index 2
+        counts = counts.astype(jnp.dtype(out_dtype))
+    sat = jnp.minimum(counts, jnp.uint32(7))
+    return jnp.where(sat < counts, jnp.uint32(0xFFFFFFFF), sat)
+
+
+def host_side_decider(values):
+    """Host code may sync freely — nothing here is jitted."""
+    total = int(values.sum())
+    as_list = values.tolist()
+    if total > 0:
+        time.sleep(0)  # not under any lock
+    return as_list
+
+
+class DisciplinedWorker:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self._intake_q = queue.Queue()
+        self._pending = 0
+
+    def locked_only(self):
+        with self._state_lock:
+            self._pending += 1  # every non-init write is under the lock
+
+    def bounded_get(self):
+        # Blocking work OUTSIDE the lock, bounded get inside.
+        item = self._intake_q.get(timeout=0.5)
+        with self._state_lock:
+            self._pending -= 1
+        return item

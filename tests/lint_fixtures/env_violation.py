@@ -1,0 +1,11 @@
+"""Seeded env-discipline violations (AST-only fixture)."""
+
+import os
+
+
+def backend_flavor() -> str:
+    return os.environ.get("BACKEND_TYPE", "tpu")  # VIOLATION
+
+
+def log_level() -> str:
+    return os.getenv("LOG_LEVEL", "WARN")  # VIOLATION

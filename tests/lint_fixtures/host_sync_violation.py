@@ -1,0 +1,44 @@
+"""Seeded jax-host-sync violations (NEVER imported — parsed by AST
+only, so the bogus jax usage is harmless).  Line numbers are asserted
+by tests/test_lint_engine.py; edit with care."""
+
+import functools
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def item_sync(x):
+    return x.item()  # VIOLATION: .item() host sync
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def cast_and_branch(x, mode):
+    if mode:  # clean: static arg, python branch is fine
+        x = x + 1
+    if x > 0:  # VIOLATION: branch on traced arg
+        x = x - 1
+    return float(x)  # VIOLATION: float() concretizes a tracer
+
+
+def referenced_body(c):
+    return np.asarray(c)  # VIOLATION: jitted by reference below
+
+
+stepped = jax.jit(jax.shard_map(referenced_body, mesh=None))
+
+
+def wrapper(fn):
+    return jax.jit(fn, donate_argnums=0)
+
+
+def wrapped_body(c):
+    return c.tolist()  # VIOLATION: jitted through the local wrapper
+
+
+built = wrapper(wrapped_body)
+
+
+def plain_host_fn(x):
+    return x.item()  # clean: not jitted, .item() is fine on host

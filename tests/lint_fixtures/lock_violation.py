@@ -1,0 +1,52 @@
+"""Seeded lock-discipline violations (AST-only fixture; line numbers
+asserted by tests/test_lint_engine.py)."""
+
+import queue
+import threading
+import time
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._work_q = queue.Queue()
+        self._done = threading.Event()
+        self.counter = 0  # __init__ writes are happens-before: clean
+
+    def sleep_under_lock(self):
+        with self._lock:
+            time.sleep(0.5)  # VIOLATION: blocking sleep under lock
+
+    def untimed_queue_get(self):
+        with self._lock:
+            return self._work_q.get()  # VIOLATION: untimed queue get
+
+    def timed_queue_get_is_fine(self):
+        with self._lock:
+            return self._work_q.get(timeout=1.0)  # clean: bounded
+
+    def foreign_wait(self):
+        with self._lock:
+            self._done.wait()  # VIOLATION: waits on a non-lock object
+
+    def locked_increment(self):
+        with self._lock:
+            self.counter += 1  # one side of the split-lock mutation
+
+    def unlocked_increment(self):
+        self.counter += 1  # VIOLATION: races locked_increment
+
+
+class CvWorker:
+    """cv.wait() inside `with cv:` releases the cv's own lock — the
+    canonical pattern must stay clean."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.items = []
+
+    def take(self):
+        with self._cv:
+            while not self.items:
+                self._cv.wait()  # clean: waiting on the held cv
+            return self.items.pop()

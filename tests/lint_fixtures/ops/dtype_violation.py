@@ -1,0 +1,18 @@
+"""Seeded dtype-discipline violations.  Lives under an ops/ directory
+because the rule only scans kernel packages (ops/models/parallel)."""
+
+import jax.numpy as jnp
+
+
+def sloppy_update(counts, slots, hits):
+    counts = counts.at[slots].set(0)  # VIOLATION: bare literal scatter
+    counts = counts.at[slots].add(1)  # VIOLATION: bare literal scatter
+    counts = counts.at[slots].add(-1)  # VIOLATION: unary minus literal
+    return counts
+
+
+def clean_update(counts, slots, hits):
+    counts = counts.at[slots].set(jnp.uint32(0))  # clean: explicit dtype
+    counts = counts.at[slots].add(hits.astype(jnp.uint32))  # clean
+    before = counts.at[slots].get(mode="fill", fill_value=0)  # clean: gather
+    return counts, before

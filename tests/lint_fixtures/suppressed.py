@@ -1,0 +1,29 @@
+"""Every violation here carries a tpu-lint suppression: the engine
+must report NOTHING for this file."""
+
+import os
+import threading
+import time
+
+import jax
+
+# tpu-lint: disable-file=jax-host-sync -- fixture exercises file-level scope
+
+
+@jax.jit
+def sync_everywhere(x):
+    return x.item()  # suppressed by the disable-file above
+
+
+def flavor() -> str:
+    # fixture: same-line suppression with justification
+    return os.environ.get("FLAVOR", "")  # tpu-lint: disable=env-discipline -- fixture
+
+
+class Sleeper:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def nap(self):
+        with self._lock:
+            time.sleep(1)  # tpu-lint: disable=lock-discipline -- fixture

@@ -546,3 +546,17 @@ def test_proxy_debug_listener_serves_stats_and_health():
     finally:
         srv.stop()
         holder.close()
+
+
+def test_debug_listener_defaults_to_loopback():
+    """ADVICE r5: the debug listener is unauthenticated, so it must
+    NOT inherit --host (0.0.0.0); --debug-host defaults to loopback
+    and the --debug-port help text carries the warning."""
+    from ratelimit_tpu.cluster.proxy import build_arg_parser
+
+    p = build_arg_parser()
+    args = p.parse_args(["--replicas", "r0:1"])
+    assert args.host == "0.0.0.0"  # serving interface unchanged
+    assert args.debug_host == "127.0.0.1"
+    help_text = p.format_help()
+    assert "UNAUTHENTICATED" in help_text

@@ -155,7 +155,9 @@ def test_lane_flush_and_close_cover_all_dispatchers(cfg):
 
 def test_runner_builds_lanes_from_settings(tmp_path):
     """TPU_NUM_LANES=3 via Settings: the runner builds 3 lane engines,
-    splits the slot budget, and serves correctly end-to-end."""
+    splits the slot budget WITHOUT dropping the division remainder
+    (ADVICE r5: 256 over 3 lanes must serve 256 slots, not 255), and
+    serves correctly end-to-end."""
     from ratelimit_tpu.runner import create_limiter
     from ratelimit_tpu.settings import Settings
 
@@ -169,12 +171,34 @@ def test_runner_builds_lanes_from_settings(tmp_path):
     clock = PinnedTimeSource(1_000_000)
     cache = create_limiter(s, Manager(), None, clock)
     assert len(cache.lanes) == 3
-    assert all(e.model.num_slots == (1 << 8) // 3 for e in cache.lanes)
+    per_lane = [e.model.num_slots for e in cache.lanes]
+    # The per-lane sum is exactly TPU_NUM_SLOTS: the remainder lands
+    # on the first lanes (256 = 86 + 85 + 85), never on the floor.
+    assert sum(per_lane) == 1 << 8
+    assert max(per_lane) - min(per_lane) <= 1
+    assert per_lane == sorted(per_lane, reverse=True)
     cfg = load_config([ConfigFile("config.lanes", YAML)], Manager())
     req = _req(["rn"])
     rules = _rules(cfg, req)
     codes = [cache.do_limit(req, rules)[0].code for _ in range(6)]
     assert codes == [Code.OK] * 5 + [Code.OVER_LIMIT]
+
+
+def test_lane_slot_split_distributes_remainder():
+    """Unit contract of the split helper: sums are exact for every
+    remainder class, lanes differ by at most one slot, and degenerate
+    totals still give every lane a usable (>=1 slot) table."""
+    from ratelimit_tpu.runner import lane_slot_split
+
+    for total, lanes in [(1 << 20, 3), (1030, 4), (256, 3), (7, 7), (8, 3)]:
+        split = lane_slot_split(total, lanes)
+        assert len(split) == lanes
+        assert sum(split) == total
+        assert max(split) - min(split) <= 1
+    # total < n_lanes: every lane still gets >= 1 slot (engines with a
+    # zero-slot table cannot serve), so the sum exceeds the total.
+    assert lane_slot_split(2, 4) == [1, 1, 1, 1]
+    assert lane_slot_split(1 << 20, 1) == [1 << 20]
 
 def test_topology_change_refuses_cross_role_restore(cfg, tmp_path):
     """A lane bank must never restore into a different-purpose engine

@@ -184,6 +184,10 @@ def test_compile_cache_dir_populated(tmp_path_factory, monkeypatch):
 
     cache_dir = str(tmp_path_factory.mktemp("xla-cache"))
     prev_min_compile = jax.config.jax_persistent_cache_min_compile_time_secs
+    # Order-independence: earlier tests may have compiled the same
+    # kernel shapes, and in-memory jit cache hits never reach the
+    # persistent cache — force a fresh compile after the dir is set.
+    jax.clear_caches()
     r = _make_runner(
         tmp_path_factory,
         "cc-runtime",
@@ -192,6 +196,13 @@ def test_compile_cache_dir_populated(tmp_path_factory, monkeypatch):
         tpu_compile_cache_dir=cache_dir,
     )
     r.start()
+    # If an earlier test already initialized the persistent cache
+    # module (with no dir), the runner's config update is not picked
+    # up until the cache resets; production processes set the dir
+    # before any jit so they never need this.
+    from jax.experimental.compilation_cache import compilation_cache as _cc
+
+    _cc.reset_cache()
     try:
         resp = _call(r, _request([("limited", "cc")]))
         assert resp.overall_code == rls_pb2.RateLimitResponse.OK

@@ -11,10 +11,17 @@ import datetime
 import ipaddress
 import os
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import rsa
-from cryptography.x509.oid import NameOID
+import pytest
+
+# `cryptography` is test-only (the `test` optional-dependency group in
+# pyproject.toml): on a clean runtime install the TLS tests SKIP at
+# collection instead of erroring the whole suite.
+pytest.importorskip("cryptography")
+
+from cryptography import x509  # noqa: E402
+from cryptography.hazmat.primitives import hashes, serialization  # noqa: E402
+from cryptography.hazmat.primitives.asymmetric import rsa  # noqa: E402
+from cryptography.x509.oid import NameOID  # noqa: E402
 
 
 def _key():
