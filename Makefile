@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: all test lint bench protos native serve check_config smoke_client docker_image e2e e2e-local ci clean
+.PHONY: all test lint bench protos native serve check_config smoke_client metrics-smoke docker_image e2e e2e-local ci clean
 
 # C++ hot-path library: slot table + decide kernel (auto-built on
 # first import too; this forces it).  Goes through the Python builder
@@ -49,6 +49,13 @@ smoke_client:
 	$(PY) -m ratelimit_tpu.cli.client --dial_string localhost:8081 \
 	  --domain rl --descriptors foo=bar
 
+# Observability smoke: in-process server, one traced RPC, then assert
+# /metrics (Prometheus text, cumulative phase buckets) and
+# /debug/tracez (trace visible under the inbound traceparent id) are
+# well-formed (docs/OBSERVABILITY.md).
+metrics-smoke:
+	$(CPU_ENV) $(PY) scripts/metrics_smoke.py
+
 docker_image:
 	docker build -t ratelimit-tpu:latest .
 
@@ -70,7 +77,7 @@ e2e-local:
 # The full CI recipe (.github/workflows/ci.yaml runs exactly this):
 # native build, tests, offline config validation, black-box e2e,
 # bench smoke on the CPU platform.
-ci: lint native test check_config e2e-local
+ci: lint native test check_config metrics-smoke e2e-local
 	$(CPU_ENV) PALLAS_AXON_POOL_IPS= $(PY) bench.py
 
 clean:

@@ -592,6 +592,105 @@ class DtypeDisciplineRule(Rule):
             )
 
 
+# ---------------------------------------------------------------------------
+# timing-discipline
+# ---------------------------------------------------------------------------
+
+
+class TimingDisciplineRule(Rule):
+    """``time.time()`` in duration arithmetic.
+
+    The wall clock is not monotonic: NTP slews/steps and manual sets
+    make ``time.time() - t0`` go negative or jump hours — precisely
+    the failure class the per-phase latency histograms and trace spans
+    exist to measure honestly (observability/).  Durations belong to
+    ``time.perf_counter()`` / ``time.monotonic()``; wall clock is for
+    TIMESTAMPS (logging, persistence, cross-process stamps).
+
+    Flags a subtraction where either operand is a direct
+    ``time.time()`` call, or a name bound from ``time.time()`` in the
+    same function (or module) scope.  Additions and comparisons are
+    untouched — storing or displaying wall stamps is fine.
+    """
+
+    id = "timing-discipline"
+    description = "time.time() used in duration arithmetic"
+    interests = (ast.BinOp,)
+
+    def begin_file(self, ctx: FileContext) -> None:
+        self._wall_callees = {"time.time"}
+        # `from time import time` makes the bare call wall-clock too.
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "time":
+                if any(a.name == "time" for a in node.names):
+                    self._wall_callees.add("time")
+        # scope node (FunctionDef or the Module) -> names bound from a
+        # wall-clock call within it.
+        self._wall_names: Dict[Optional[ast.AST], Set[str]] = {}
+        self._collect_wall_names(ctx.tree)
+
+    def _is_wall_call(self, node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and dotted_name(node.func) in self._wall_callees
+        )
+
+    def _collect_wall_names(self, tree: ast.Module) -> None:
+        def scan(scope: ast.AST, body) -> None:
+            # Walk WITHOUT descending into nested function defs: their
+            # assignments belong to their own scope entry.
+            names: Set[str] = set()
+            stack = list(body)
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(node, ast.Assign) and self._is_wall_call(
+                    node.value
+                ):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            names.add(t.id)
+                stack.extend(ast.iter_child_nodes(node))
+            self._wall_names[scope] = names
+
+        scan(tree, tree.body)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan(node, node.body)
+
+    def _is_wall(
+        self, node: ast.AST, parents: Sequence[ast.AST], ctx: FileContext
+    ) -> bool:
+        if self._is_wall_call(node):
+            return True
+        if isinstance(node, ast.Name):
+            fn = None
+            for p in reversed(parents):
+                if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fn = p
+                    break
+            if node.id in self._wall_names.get(fn, ()):
+                return True
+            if node.id in self._wall_names.get(ctx.tree, ()):
+                return True
+        return False
+
+    def visit(self, node, parents, ctx: FileContext) -> None:
+        if not isinstance(node.op, ast.Sub):
+            return
+        if self._is_wall(node.left, parents, ctx) or self._is_wall(
+            node.right, parents, ctx
+        ):
+            self.report(
+                ctx,
+                node,
+                "time.time() in duration arithmetic: the wall clock "
+                "steps under NTP; use time.perf_counter()/monotonic() "
+                "for durations (wall clock is for timestamps)",
+            )
+
+
 def _make_default_rules() -> List[Rule]:
     """Fresh rule instances (rules hold per-file state; concurrent
     engines must not share them — tests construct their own packs)."""
@@ -600,6 +699,7 @@ def _make_default_rules() -> List[Rule]:
         LockDisciplineRule(),
         EnvDisciplineRule(),
         DtypeDisciplineRule(),
+        TimingDisciplineRule(),
     ]
 
 

@@ -140,7 +140,7 @@ def restore_engine(engine, path: str, role: str = "") -> bool:
         "restored %d live keys from %s (saved %.0fs ago)",
         len(entries),
         path,
-        time.time() - meta.get("saved_at", 0),
+        time.time() - meta.get("saved_at", 0),  # tpu-lint: disable=timing-discipline -- cross-restart age: wall stamps are all that survive a process boundary
     )
     return True
 

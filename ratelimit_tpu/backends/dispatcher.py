@@ -134,7 +134,10 @@ class WorkItem:
     # perf_counter() at "launch" (collector hands the batch to the
     # device) and "complete" (readback+decide done, waiter signalled).
     # The submitter owns "submit"/"applied".  Powers the closed-loop
-    # latency harness (benchmarks/closed_loop_p99.py); None in serving.
+    # latency harness (benchmarks/closed_loop_p99.py) and, in serving,
+    # the request tracer: tpu_cache sets it on SAMPLED requests and
+    # converts the stamps to dispatch/kernel spans after wait()
+    # (observability/trace.py).  None on the unsampled hot path.
     trace: Optional[dict] = None
     event: threading.Event = field(default_factory=threading.Event)
     error: Optional[BaseException] = None

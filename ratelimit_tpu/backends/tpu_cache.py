@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 from typing import List, Optional, Sequence, Union
 from zlib import crc32
 
@@ -36,6 +37,7 @@ import numpy as np
 
 from ..api import Code, DescriptorStatus, RateLimitRequest
 from ..config import RateLimitRule
+from ..observability import TRACER
 from ..limiter.cache_key import CacheKeyGenerator
 from ..limiter.local_cache import LocalCache
 from ..utils.time import (
@@ -201,18 +203,24 @@ class TpuRateLimitCache:
             (lane, rows) for lane, rows in zip(self.lanes, rows_by_lane)
         ]
         pairs.append((self.per_second_engine, per_second_rows))
+        # When this request's trace is recording, stamp each item's
+        # dispatcher passage (submit here; launch/complete on the
+        # dispatcher threads via the WorkItem trace seam) and convert
+        # the stamps to spans after wait() — see _record_item_spans.
+        span = TRACER.current()
         items: List[tuple] = []  # (engine, WorkItem)
-        for engine, rows in pairs:
+        for bank, (engine, rows) in enumerate(pairs):
             if not rows:
                 continue
-            items.append(
-                (
-                    engine,
-                    self._make_item(
-                        rows, keys, limits, hits_addend, now, statuses, enc_keys
-                    ),
-                )
+            item = self._make_item(
+                rows, keys, limits, hits_addend, now, statuses, enc_keys
             )
+            if span is not None:
+                item.trace = {
+                    "bank": "per_second" if bank == n_lanes else f"lane{bank}",
+                    "submit": time.perf_counter(),
+                }
+            items.append((engine, item))
 
         # Submit all banks first, then wait: the two banks' device
         # steps overlap (the reference likewise pipelines both Redis
@@ -244,6 +252,8 @@ class TpuRateLimitCache:
                 from ..service import CacheError
 
                 raise CacheError(f"counter engine failure: {e}") from e
+        if span is not None:
+            self._record_item_spans(span, items)
 
         # Non-engine categories.
         reset_cache: dict = {}
@@ -408,6 +418,34 @@ class TpuRateLimitCache:
                     engine.step(batch)
 
     # -- internals -------------------------------------------------------
+
+    @staticmethod
+    def _record_item_spans(span, items: List[tuple]) -> None:
+        """Turn each item's (submit, launch, complete) perf_counter
+        stamps into two child spans — ``backend.dispatch`` (intake
+        queue + collect + batch assembly, host-side) and
+        ``kernel.step`` (device launch through readback+decide) — on
+        the waiting RPC thread, after the completion event's
+        happens-before edge made the dispatcher threads' stamps
+        visible.  Failed steps leave stamps missing; record what
+        exists."""
+        for _, item in items:
+            tr = item.trace
+            launch = tr.get("launch")
+            complete = tr.get("complete")
+            attrs = {"bank": tr["bank"], "lanes": item.n_lanes}
+            if launch is not None:
+                TRACER.record_span(
+                    "backend.dispatch",
+                    tr["submit"],
+                    launch,
+                    attrs=attrs,
+                    parent=span,
+                )
+                if complete is not None:
+                    TRACER.record_span(
+                        "kernel.step", launch, complete, attrs=attrs, parent=span
+                    )
 
     def _make_item(
         self,

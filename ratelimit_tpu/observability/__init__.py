@@ -1,0 +1,35 @@
+"""Request tracing + metrics exposition (docs/OBSERVABILITY.md).
+
+- ``trace``:      spans, W3C traceparent, sampling, the trace ring,
+                  JSONL/log exporters, and the process-wide TRACER.
+- ``prometheus``: text exposition for ``GET /metrics``.
+- ``tracez``:     ``GET /debug/tracez`` rendering.
+"""
+
+from .trace import (
+    NOOP_SPAN,
+    TRACEPARENT_HEADER,
+    FinishedTrace,
+    JsonlExporter,
+    Span,
+    SpanContext,
+    TRACER,
+    Tracer,
+    format_traceparent,
+    log_exporter,
+    parse_traceparent,
+)
+
+__all__ = [
+    "NOOP_SPAN",
+    "TRACEPARENT_HEADER",
+    "FinishedTrace",
+    "JsonlExporter",
+    "Span",
+    "SpanContext",
+    "TRACER",
+    "Tracer",
+    "format_traceparent",
+    "log_exporter",
+    "parse_traceparent",
+]

@@ -173,6 +173,7 @@ class Runner:
         self.statsd = None
         self.health = None
         self.checkpointer = None
+        self._trace_jsonl = None
 
     # -- lifecycle (runner.go:76-143) -----------------------------------
 
@@ -210,6 +211,25 @@ class Runner:
             add_healthcheck,
             add_json_handler,
         )
+
+        # Tracing policy + exporters (docs/OBSERVABILITY.md).  The
+        # process-wide tracer is configured here, once, from Settings —
+        # the serving layers reference it like they reference logging.
+        from .observability import JsonlExporter, TRACER, log_exporter
+
+        TRACER.configure(
+            sample_rate=s.trace_sample_rate,
+            sample_errors=s.trace_sample_errors,
+            enabled=s.trace_sample_rate > 0 or s.trace_sample_errors,
+            ring_size=s.trace_ring_size,
+            slow_size=s.trace_slow_size,
+        )
+        TRACER.clear_exporters()
+        if s.trace_export_jsonl:
+            self._trace_jsonl = JsonlExporter(s.trace_export_jsonl)
+            TRACER.add_exporter(self._trace_jsonl)
+        if s.trace_log:
+            TRACER.add_exporter(log_exporter)
 
         local_cache = None
         if s.local_cache_size_in_bytes > 0:
@@ -367,6 +387,12 @@ class Runner:
             self.statsd.stop()
         if self.cache is not None and hasattr(self.cache, "close"):
             self.cache.close()
+        if self._trace_jsonl is not None:
+            from .observability import TRACER
+
+            TRACER.clear_exporters()
+            self._trace_jsonl.close()
+            self._trace_jsonl = None
         self._stopped.set()
 
 

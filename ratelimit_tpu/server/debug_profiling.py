@@ -178,7 +178,9 @@ def add_profiling_routes(
             b"  /debug/threadz              all-thread stack dump\n"
             b"  /debug/profile?seconds=N    statistical CPU profile\n"
             b"  /debug/xla_trace?seconds=N  jax.profiler trace capture\n"
-            b"  /stats                      counters/gauges/timers\n",
+            b"  /debug/tracez               slowest + recent request traces\n"
+            b"  /stats                      counters/gauges/timers/histograms\n"
+            b"  /metrics                    Prometheus text exposition\n",
         )
 
     server.add_route("GET", "/debug/threadz", threadz)

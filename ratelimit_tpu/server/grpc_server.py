@@ -25,6 +25,7 @@ from . import pb  # noqa: F401  (sys.path setup)
 from envoy.service.ratelimit.v3 import rls_pb2  # noqa: E402
 from grpchealth.v1 import health_pb2  # noqa: E402
 
+from ..observability import TRACEPARENT_HEADER, TRACER  # noqa: E402
 from ..service import CacheError, ServiceError  # noqa: E402
 from ..stats.manager import StatsStore  # noqa: E402
 from .codec import request_from_pb, response_to_pb  # noqa: E402
@@ -38,16 +39,35 @@ HEALTH_SERVICE = "grpc.health.v1.Health"
 
 class ServerReporter:
     """Per-method total_requests counter + response_time ms timer
-    (reference src/metrics/metrics.go:30-46)."""
+    (reference src/metrics/metrics.go:30-46), plus per-phase latency
+    HISTOGRAMS fed straight from the handler's perf_counter stamps —
+    unlike the Timer sample path (which drops past MAX_SAMPLES per
+    flush), every request lands in a bucket, so /metrics p99s are
+    exact bucket math, not a sampled subset."""
 
     def __init__(self, store: StatsStore, scope: str = "ratelimit_server"):
         self.store = store
         self.scope = scope
+        base = f"{scope}.ShouldRateLimit"
+        self._phase_decode = store.histogram(base + ".phase.decode_ms")
+        self._phase_service = store.histogram(base + ".phase.service_ms")
+        self._phase_serialize = store.histogram(base + ".phase.serialize_ms")
+        self._response = store.histogram(base + ".response_ms")
 
     def observe(self, method: str, elapsed_s: float) -> None:
         base = f"{self.scope}.{method}"
         self.store.counter(base + ".total_requests").inc()
         self.store.timer(base + ".response_time").add_duration_ms(elapsed_s * 1e3)
+
+    def observe_phases(
+        self, recv: float, decoded: float, serviced: float, serialized: float
+    ) -> None:
+        """The four handler stamps -> three phase histograms + total
+        (stamps are perf_counter seconds; buckets are ms)."""
+        self._phase_decode.observe((decoded - recv) * 1e3)
+        self._phase_service.observe((serviced - decoded) * 1e3)
+        self._phase_serialize.observe((serialized - serviced) * 1e3)
+        self._response.observe((serialized - recv) * 1e3)
 
 
 # Optional per-RPC stage-timestamp sink (the transport half of the
@@ -56,7 +76,10 @@ class ServerReporter:
 # stamps per ShouldRateLimit.  The reference's analog is the
 # response_time interceptor timing the full RPC (metrics.go:37-46);
 # this decomposes it.  A one-element list so the live handler closure
-# sees updates; the per-call cost when unset is one load + None check.
+# sees updates.  The same four stamps now ALSO feed the per-phase
+# latency histograms unconditionally (ServerReporter.observe_phases) —
+# perf_counter is ~40ns, so always stamping costs less than branching
+# did.
 _stage_sink = [None]
 
 
@@ -68,30 +91,57 @@ def set_stage_sink(fn) -> None:
 
 def _ratelimit_handler(service, reporter: Optional[ServerReporter]):
     serialize = rls_pb2.RateLimitResponse.SerializeToString
+    from ..api import Code as _Code
 
     def should_rate_limit(request_pb, context):
         start = time.perf_counter()
+        # Trace intake: an inbound W3C traceparent (Envoy and any OTel
+        # client send one as plain metadata) adopts the caller's trace
+        # id and sampling decision; otherwise head-sampling applies.
+        # The metadata scan is gated so a disabled tracer costs one
+        # attribute load.
+        traceparent = None
+        if TRACER.enabled:
+            for k, v in context.invocation_metadata():
+                if k == TRACEPARENT_HEADER:
+                    traceparent = v
+                    break
+        root = TRACER.start_span("grpc.should_rate_limit", traceparent)
         try:
-            request = request_from_pb(request_pb)
-            sink = _stage_sink[0]
-            t_decoded = time.perf_counter() if sink is not None else 0.0
-            try:
-                response = service.should_rate_limit(request)
-            except (ServiceError, CacheError) as e:
-                # grpc-go turns a plain returned error into UNKNOWN;
-                # mirror that mapping (service/ratelimit.go:239-265).
-                context.abort(grpc.StatusCode.UNKNOWN, str(e))
-            # Serialize HERE on the handler thread (the method is
-            # registered with an identity response_serializer): the
-            # bytes leave this function ready to send, so the time
-            # between return and the socket write is purely grpcio —
-            # attribution needs that boundary to be clean.
-            if sink is not None:
+            with root:
+                with TRACER.span("decode"):
+                    request = request_from_pb(request_pb)
+                t_decoded = time.perf_counter()
+                try:
+                    response = service.should_rate_limit(request)
+                except (ServiceError, CacheError) as e:
+                    # grpc-go turns a plain returned error into UNKNOWN;
+                    # mirror that mapping (service/ratelimit.go:239-265).
+                    root.set_status("error", str(e))
+                    context.abort(grpc.StatusCode.UNKNOWN, str(e))
                 t_serviced = time.perf_counter()
-            payload = serialize(response_to_pb(response))
-            if sink is not None:
-                sink(start, t_decoded, t_serviced, time.perf_counter())
-            return payload
+                # Serialize HERE on the handler thread (the method is
+                # registered with an identity response_serializer): the
+                # bytes leave this function ready to send, so the time
+                # between return and the socket write is purely grpcio —
+                # attribution needs that boundary to be clean.
+                with TRACER.span("serialize"):
+                    payload = serialize(response_to_pb(response))
+                t_serialized = time.perf_counter()
+                root.set_attr("domain", request.domain)
+                root.set_attr("descriptors", len(request.descriptors))
+                if response.overall_code == _Code.OVER_LIMIT:
+                    # Tail-sampling override: over-limit decisions are
+                    # always worth keeping (observability/trace.py).
+                    root.set_status("over_limit")
+                sink = _stage_sink[0]
+                if sink is not None:
+                    sink(start, t_decoded, t_serviced, t_serialized)
+                if reporter is not None:
+                    reporter.observe_phases(
+                        start, t_decoded, t_serviced, t_serialized
+                    )
+                return payload
         finally:
             if reporter is not None:
                 reporter.observe("ShouldRateLimit", time.perf_counter() - start)

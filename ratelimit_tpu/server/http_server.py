@@ -9,13 +9,15 @@ API server routes (server_impl.go:110-117, 227-233):
 - GET  /healthcheck 200 "OK" / 500 per HealthChecker.
 
 Debug server routes (server_impl.go:238-269, runner.go:117-124):
-- GET /stats            flat counters/gauges/timers dump
+- GET /stats            flat counters/gauges/timers/histograms dump
+- GET /metrics          Prometheus text exposition (scrape target)
 - GET /rlconfig         current config dump
+- GET /debug/tracez     slowest + most recent request traces
 - GET /debug/pprof/     index of the live-introspection endpoints
 - GET /debug/threadz    all-thread stack dump
 - GET /debug/profile    statistical all-thread CPU profile
 - GET /debug/xla_trace  jax.profiler trace capture
-(see server/debug_profiling.py)
+(see server/debug_profiling.py and docs/OBSERVABILITY.md)
 """
 
 from __future__ import annotations
@@ -32,6 +34,7 @@ from . import pb  # noqa: F401
 
 from envoy.service.ratelimit.v3 import rls_pb2  # noqa: E402
 
+from ..observability import TRACEPARENT_HEADER, TRACER  # noqa: E402
 from ..service import CacheError, ServiceError  # noqa: E402
 from .codec import request_from_pb, response_to_pb  # noqa: E402
 from .health import HealthChecker  # noqa: E402
@@ -57,10 +60,19 @@ def _make_handler(router: _Router):
         def log_message(self, fmt, *args):  # route to logging, not stderr
             logger.debug("%s " + fmt, self.address_string(), *args)
 
-        def _reply(self, code: int, body: bytes, content_type: str = "text/plain"):
+        def _reply(
+            self,
+            code: int,
+            body: bytes,
+            content_type: str = "text/plain",
+            extra_headers=None,
+        ):
             self.send_response(code)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
+            if extra_headers:
+                for k, v in extra_headers:
+                    self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -124,32 +136,61 @@ class HttpServer:
 
 def add_json_handler(server: HttpServer, service) -> None:
     """POST /json bridge (reference NewJsonHandler,
-    server_impl.go:71-109)."""
+    server_impl.go:71-109).  Participates in tracing like the gRPC
+    handler: an inbound ``traceparent`` header adopts the caller's
+    trace, and a recording request echoes its own traceparent back as
+    a response header so the client can find it in /debug/tracez."""
 
     def handle(h) -> None:
-        length = int(h.headers.get("Content-Length") or 0)
-        body = h.rfile.read(length) if length else b""
-        request_pb = rls_pb2.RateLimitRequest()
-        try:
-            json_format.Parse(body.decode("utf-8"), request_pb)
-        except Exception as e:
-            h._reply(400, f"error parsing request body: {e}\n".encode())
-            return
-        try:
-            response = service.should_rate_limit(request_from_pb(request_pb))
-        except (ServiceError, CacheError) as e:
-            h._reply(500, f"{e}\n".encode())
-            return
-        response_pb = response_to_pb(response)
-        out = json_format.MessageToJson(response_pb).encode("utf-8")
-        code = rls_pb2.RateLimitResponse.Code.Name(response_pb.overall_code)
-        if code == "OK":
-            status = 200
-        elif code == "OVER_LIMIT":
-            status = 429
-        else:
-            status = 500
-        h._reply(status, out, content_type="application/json")
+        root = TRACER.start_span(
+            "http.json", h.headers.get(TRACEPARENT_HEADER)
+        )
+        status, out, ctype = 500, b"", "text/plain"
+        # The reply is sent AFTER the root span exits: the trace must
+        # be committed (visible in the ring / exporters) before the
+        # client can observe the response — a client that reads
+        # /debug/tracez right after this reply must find its trace.
+        with root:
+            length = int(h.headers.get("Content-Length") or 0)
+            body = h.rfile.read(length) if length else b""
+            request_pb = rls_pb2.RateLimitRequest()
+            try:
+                with TRACER.span("decode"):
+                    json_format.Parse(body.decode("utf-8"), request_pb)
+                    request = request_from_pb(request_pb)
+            except Exception as e:
+                root.set_status("error", f"bad request body: {e}")
+                status, out = 400, f"error parsing request body: {e}\n".encode()
+                request = None
+            if request is not None:
+                try:
+                    response = service.should_rate_limit(request)
+                except (ServiceError, CacheError) as e:
+                    root.set_status("error", str(e))
+                    status, out = 500, f"{e}\n".encode()
+                else:
+                    with TRACER.span("serialize"):
+                        response_pb = response_to_pb(response)
+                        out = json_format.MessageToJson(response_pb).encode(
+                            "utf-8"
+                        )
+                    ctype = "application/json"
+                    code = rls_pb2.RateLimitResponse.Code.Name(
+                        response_pb.overall_code
+                    )
+                    if code == "OK":
+                        status = 200
+                    elif code == "OVER_LIMIT":
+                        status = 429
+                        root.set_status("over_limit")
+                    else:
+                        status = 500
+        headers = (
+            [(TRACEPARENT_HEADER, root.traceparent())]
+            if root.recording
+            else None
+        )
+        h._reply(status, out, content_type=ctype, extra_headers=headers)
 
     server.add_route("POST", "/json", handle)
 
@@ -175,6 +216,13 @@ def add_debug_routes(server: HttpServer, store, service=None) -> None:
             lines.append(
                 f"{name}: count={summary['count']} "
                 f"mean_ms={summary['mean_ms']:.3f} max_ms={summary['max_ms']:.3f}"
+                f" samples_dropped={int(summary['samples_dropped'])}"
+            )
+        for name, summary in sorted(store.histograms().items()):
+            lines.append(
+                f"{name}: count={summary['count']} "
+                f"p50_ms={summary['p50_ms']:.3f} p90_ms={summary['p90_ms']:.3f} "
+                f"p99_ms={summary['p99_ms']:.3f} max_ms={summary['max_ms']:.3f}"
             )
         h._reply(200, ("\n".join(lines) + "\n").encode())
 
@@ -182,13 +230,31 @@ def add_debug_routes(server: HttpServer, store, service=None) -> None:
         h._reply(
             200,
             json.dumps(
-                {"stats": store.snapshot(), "timers": store.timers()}
+                {
+                    "stats": store.snapshot(),
+                    "timers": store.timers(),
+                    "histograms": store.histograms(),
+                }
             ).encode(),
             content_type="application/json",
         )
 
+    # Prometheus scrape surface + trace zPage (docs/OBSERVABILITY.md).
+    from ..observability import prometheus as _prom
+    from ..observability import tracez as _tracez
+
+    def metrics(h) -> None:
+        h._reply(
+            200, _prom.render(store).encode(), content_type=_prom.CONTENT_TYPE
+        )
+
+    def tracez(h) -> None:
+        h._reply(200, _tracez.render(TRACER).encode())
+
     server.add_route("GET", "/stats", stats)
     server.add_route("GET", "/stats.json", stats_json)
+    server.add_route("GET", "/metrics", metrics)
+    server.add_route("GET", "/debug/tracez", tracez)
 
     if service is not None:
 

@@ -26,6 +26,7 @@ from ..api import (
     RateLimitResponse,
 )
 from ..config.loader import ConfigError, ConfigFile, RateLimitConfig, load_config
+from ..observability import TRACER
 from ..stats.manager import Manager
 from ..utils.time import RealTimeSource, TimeSource, calculate_reset
 
@@ -148,7 +149,13 @@ class RateLimitService:
             raise ServiceError("rate limit descriptor list must not be empty")
 
         limits, is_unlimited = self._construct_limits_to_check(request)
-        statuses = self.cache.do_limit(request, limits)
+        # The backend leg as its own span: whatever cache is plugged in
+        # (tpu dispatcher, write-behind, memory) its full do_limit cost
+        # separates from rule lookup + response assembly; the tpu cache
+        # nests dispatch/kernel spans inside (backends/tpu_cache.py).
+        with TRACER.span("backend.do_limit") as span:
+            span.set_attr("backend", type(self.cache).__name__)
+            statuses = self.cache.do_limit(request, limits)
         assert len(limits) == len(statuses)
 
         response = RateLimitResponse()
@@ -206,11 +213,12 @@ class RateLimitService:
     def should_rate_limit(self, request: RateLimitRequest) -> RateLimitResponse:
         """Entry point; raises ServiceError/CacheError after counting
         them (the recover() block, ratelimit.go:243-265)."""
-        try:
-            return self._should_rate_limit_worker(request)
-        except CacheError:
-            self.stats.should_rate_limit.redis_error.inc()
-            raise
-        except ServiceError:
-            self.stats.should_rate_limit.service_error.inc()
-            raise
+        with TRACER.span("service.should_rate_limit"):
+            try:
+                return self._should_rate_limit_worker(request)
+            except CacheError:
+                self.stats.should_rate_limit.redis_error.inc()
+                raise
+            except ServiceError:
+                self.stats.should_rate_limit.service_error.inc()
+                raise

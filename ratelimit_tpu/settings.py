@@ -207,6 +207,23 @@ class Settings:
     # disabled.
     tpu_compile_cache_dir: str = ""
 
+    # Request tracing (observability/trace.py; docs/OBSERVABILITY.md).
+    # Head-sampling probability for traces with no inbound traceparent
+    # (an inbound sampled flag always wins); 0.0 = only errors and
+    # over-limit decisions are kept (when trace_sample_errors).
+    trace_sample_rate: float = 0.0
+    # Always commit traces that end in an error or OVER_LIMIT, even
+    # when the head decision said no.  False + rate 0.0 disables
+    # recording entirely (the NOOP_SPAN fast path).
+    trace_sample_errors: bool = True
+    # Bounded in-memory rings backing GET /debug/tracez.
+    trace_ring_size: int = 256
+    trace_slow_size: int = 32
+    # Exporters: append committed traces as JSON lines to this path
+    # (empty = off); log one INFO line per committed trace.
+    trace_export_jsonl: str = ""
+    trace_log: bool = False
+
     # Global shadow mode (settings.go:105).
     global_shadow_mode: bool = False
 
@@ -271,6 +288,12 @@ def new_settings() -> Settings:
         tpu_checkpoint_dir=_env_str("TPU_CHECKPOINT_DIR", ""),
         tpu_checkpoint_interval_s=_env_float("TPU_CHECKPOINT_INTERVAL_S", 30.0),
         tpu_compile_cache_dir=_env_str("TPU_COMPILE_CACHE_DIR", ""),
+        trace_sample_rate=_env_float("TRACE_SAMPLE_RATE", 0.0),
+        trace_sample_errors=_env_bool("TRACE_SAMPLE_ERRORS", True),
+        trace_ring_size=_env_int("TRACE_RING_SIZE", 256),
+        trace_slow_size=_env_int("TRACE_SLOW_SIZE", 32),
+        trace_export_jsonl=_env_str("TRACE_EXPORT_JSONL", ""),
+        trace_log=_env_bool("TRACE_LOG", False),
         global_shadow_mode=_env_bool("SHADOW_MODE", False),
     )
     return s

@@ -17,6 +17,7 @@ null) drains deltas periodically (``ratelimit_tpu.stats.sink``).
 from __future__ import annotations
 
 import threading
+from bisect import bisect_right
 from typing import Dict, Optional
 
 
@@ -76,11 +77,22 @@ class Timer:
     """Millisecond timer: count / total / max (the gostats timer the
     gRPC interceptor feeds, reference src/metrics/metrics.go:41-44)."""
 
-    __slots__ = ("name", "_count", "_total_ms", "_max_ms", "_samples", "_lock")
+    __slots__ = (
+        "name",
+        "_count",
+        "_total_ms",
+        "_max_ms",
+        "_samples",
+        "_dropped",
+        "_dropped_flushed",
+        "_lock",
+    )
 
     # Per-flush sample retention cap: statsd timers are per-observation
     # ("|ms" lines); beyond this the flush interval reports a sampled
-    # subset, which statsd aggregation tolerates.
+    # subset, which statsd aggregation tolerates.  Drops are COUNTED
+    # (``samples_dropped``) so a saturated flush interval is visible
+    # instead of silently biasing the exported distribution.
     MAX_SAMPLES = 512
 
     def __init__(self, name: str):
@@ -89,6 +101,8 @@ class Timer:
         self._total_ms = 0.0
         self._max_ms = 0.0
         self._samples: list = []
+        self._dropped = 0
+        self._dropped_flushed = 0
         self._lock = threading.Lock()
 
     def add_duration_ms(self, ms: float) -> None:
@@ -99,12 +113,22 @@ class Timer:
                 self._max_ms = ms
             if len(self._samples) < self.MAX_SAMPLES:
                 self._samples.append(ms)
+            else:
+                self._dropped += 1
 
     def drain_samples(self) -> list:
         """Samples observed since the last drain (statsd export)."""
         with self._lock:
             samples, self._samples = self._samples, []
             return samples
+
+    def drain_dropped(self) -> int:
+        """Drop count accumulated since the last drain (exported as a
+        ``<name>.timer_samples_dropped`` statsd counter)."""
+        with self._lock:
+            delta = self._dropped - self._dropped_flushed
+            self._dropped_flushed = self._dropped
+            return delta
 
     def summary(self) -> Dict[str, float]:
         with self._lock:
@@ -114,7 +138,93 @@ class Timer:
                 "total_ms": self._total_ms,
                 "mean_ms": mean,
                 "max_ms": self._max_ms,
+                "samples_dropped": self._dropped,
             }
+
+
+def _log_bounds(start_ms: float = 0.125, count: int = 18) -> tuple:
+    """Power-of-two bucket ladder: 0.125ms .. ~16.4s.  Fixed (not
+    per-histogram adaptive) so bucket series from any process align
+    and Prometheus quantile math works across restarts."""
+    return tuple(start_ms * (2**i) for i in range(count))
+
+
+class Histogram:
+    """Fixed log-bucket latency histogram (milliseconds).
+
+    The quantile-carrying successor to Timer's count/total/max: O(1)
+    memory, lock-held work is one bisect + three adds, and the bucket
+    counts expose directly as a Prometheus histogram.  ``summary()``
+    derives p50/p90/p99 by linear interpolation inside the bucket
+    containing each quantile (the same estimate PromQL's
+    histogram_quantile computes server-side).
+    """
+
+    __slots__ = ("name", "bounds", "_counts", "_sum", "_count", "_max", "_lock")
+
+    DEFAULT_BOUNDS = _log_bounds()
+
+    def __init__(self, name: str, bounds: Optional[tuple] = None):
+        self.name = name
+        self.bounds = tuple(bounds) if bounds is not None else self.DEFAULT_BOUNDS
+        # One overflow cell past the last bound (the +Inf bucket).
+        self._counts = [0] * (len(self.bounds) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._max = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, ms: float) -> None:
+        idx = bisect_right(self.bounds, ms)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += ms
+            self._count += 1
+            if ms > self._max:
+                self._max = ms
+
+    def snapshot(self):
+        """(bounds, per-bucket counts incl. overflow, sum, count) —
+        the Prometheus exposition surface."""
+        with self._lock:
+            return self.bounds, list(self._counts), self._sum, self._count
+
+    def _quantile(self, counts, q: float) -> float:
+        """Linear interpolation within the bucket holding quantile q;
+        the overflow bucket reports the last finite bound (like
+        histogram_quantile's +Inf clamp)."""
+        total = sum(counts)
+        if total == 0:
+            return 0.0
+        rank = q * total
+        cumulative = 0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if cumulative + c >= rank:
+                if i >= len(self.bounds):
+                    return self.bounds[-1]
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i]
+                frac = (rank - cumulative) / c
+                return lo + (hi - lo) * frac
+            cumulative += c
+        return self.bounds[-1]
+
+    def summary(self) -> Dict[str, float]:
+        with self._lock:
+            counts = list(self._counts)
+            total, total_sum, mx = self._count, self._sum, self._max
+        mean = total_sum / total if total else 0.0
+        return {
+            "count": total,
+            "total_ms": total_sum,
+            "mean_ms": mean,
+            "max_ms": mx,
+            "p50_ms": self._quantile(counts, 0.50),
+            "p90_ms": self._quantile(counts, 0.90),
+            "p99_ms": self._quantile(counts, 0.99),
+        }
 
 
 class StatsStore:
@@ -125,7 +235,24 @@ class StatsStore:
         self._gauges: Dict[str, Gauge] = {}
         self._gauge_fns: Dict[str, "callable"] = {}
         self._timers: Dict[str, Timer] = {}
+        self._histograms: Dict[str, Histogram] = {}
         self._lock = threading.Lock()
+
+    def histogram(self, name: str, bounds: Optional[tuple] = None) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name, bounds)
+            return h
+
+    def histogram_names(self) -> list:
+        with self._lock:
+            return list(self._histograms.keys())
+
+    def histograms(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            items = list(self._histograms.items())
+        return {name: h.summary() for name, h in items}
 
     def timer(self, name: str) -> Timer:
         with self._lock:

@@ -54,6 +54,7 @@ class StatsdExporter:
             self.addr = self._resolve_srv()  # raises SrvError on bad
             self._next_refresh = time.monotonic() + self.srv_refresh_s
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._closed = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -98,9 +99,17 @@ class StatsdExporter:
             self._thread.join(timeout=5)
             self._thread = None
         self.flush()  # final drain
+        # Release the UDP socket: tests and restart loops construct
+        # many exporters, and an unclosed fd per exporter leaks until
+        # gc finalization.  flush() after this point is a no-op.
+        self._closed = True
+        self._sock.close()
 
     def flush(self) -> None:
-        """One export cycle (also the deterministic test hook)."""
+        """One export cycle (also the deterministic test hook); no-op
+        once stop() has closed the socket."""
+        if self._closed:
+            return
         lines = []
         counters = self.store.live_counters()
         timers = self.store.live_timers()
@@ -113,6 +122,11 @@ class StatsdExporter:
         for t in timers:
             for ms in t.drain_samples():
                 lines.append(f"{t.name}:{ms:.3f}|ms")
+            dropped = t.drain_dropped()
+            if dropped:
+                # Saturated flush interval: the |ms lines above are a
+                # truncated sample — say so, countably.
+                lines.append(f"{t.name}.timer_samples_dropped:{dropped}|c")
         # Chunk into ~1400-byte datagrams (standard statsd MTU safety).
         buf: list = []
         size = 0

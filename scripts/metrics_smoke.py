@@ -1,0 +1,146 @@
+"""CI smoke for the observability surfaces (`make metrics-smoke`).
+
+Boots a real Runner in-process (CPU backend path, ephemeral ports),
+pushes one traced request through the full gRPC stack, then asserts:
+
+- GET /metrics serves well-formed Prometheus text: TYPE lines, per-
+  phase histograms with cumulative buckets, +Inf == _count;
+- GET /debug/tracez shows the request's trace (the inbound traceparent
+  id) with the kernel-phase span.
+
+Exit 0 on success; any assertion prints context and exits 1.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import urllib.request
+
+# `python scripts/metrics_smoke.py` puts scripts/ (not the repo root)
+# at sys.path[0]; make the package importable either way.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+BASIC_YAML = """
+domain: smoke
+descriptors:
+  - key: k
+    rate_limit:
+      unit: minute
+      requests_per_unit: 100
+"""
+
+
+def main() -> int:
+    import tempfile
+    from pathlib import Path
+
+    import grpc
+
+    from ratelimit_tpu.runner import Runner
+    from ratelimit_tpu.settings import Settings
+    from ratelimit_tpu.server import pb  # noqa: F401  (sys.path setup)
+    from envoy.service.ratelimit.v3 import rls_pb2
+
+    with tempfile.TemporaryDirectory() as tmp:
+        config_dir = Path(tmp) / "ratelimit" / "config"
+        config_dir.mkdir(parents=True)
+        (config_dir / "smoke.yaml").write_text(BASIC_YAML)
+        runner = Runner(
+            Settings(
+                host="127.0.0.1",
+                port=0,
+                grpc_host="127.0.0.1",
+                grpc_port=0,
+                debug_host="127.0.0.1",
+                debug_port=0,
+                use_statsd=False,
+                backend_type="tpu",
+                tpu_num_slots=1 << 10,
+                tpu_batch_window_us=200,
+                tpu_batch_buckets=[8],
+                runtime_path=tmp,
+                runtime_subdirectory="ratelimit",
+                local_cache_size_in_bytes=0,
+                expiration_jitter_max_seconds=0,
+            )
+        )
+        runner.start()
+        try:
+            trace_id = "5a" * 16
+            header = f"00-{trace_id}-{'6b' * 8}-01"
+            req = rls_pb2.RateLimitRequest(domain="smoke")
+            d = req.descriptors.add()
+            e = d.entries.add()
+            e.key, e.value = "k", "smoke"
+            with grpc.insecure_channel(
+                f"127.0.0.1:{runner.grpc_server.bound_port}"
+            ) as channel:
+                method = channel.unary_unary(
+                    "/envoy.service.ratelimit.v3.RateLimitService/"
+                    "ShouldRateLimit",
+                    request_serializer=(
+                        rls_pb2.RateLimitRequest.SerializeToString
+                    ),
+                    response_deserializer=rls_pb2.RateLimitResponse.FromString,
+                )
+                resp = method(
+                    req, timeout=60, metadata=[("traceparent", header)]
+                )
+            assert resp.overall_code == rls_pb2.RateLimitResponse.OK, resp
+
+            debug = runner.debug_server.bound_port
+
+            def get(path: str) -> str:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{debug}{path}", timeout=30
+                ) as r:
+                    assert r.status == 200, (path, r.status)
+                    return r.read().decode()
+
+            metrics = get("/metrics")
+            assert "# TYPE ratelimit_server_ShouldRateLimit_response_ms histogram" in metrics
+            for phase in ("decode", "service", "serialize"):
+                assert (
+                    f"ratelimit_server_ShouldRateLimit_phase_{phase}_ms_bucket"
+                    in metrics
+                ), phase
+            prefix = "ratelimit_server_ShouldRateLimit_response_ms"
+            buckets = [
+                int(line.rsplit(" ", 1)[1])
+                for line in metrics.splitlines()
+                if line.startswith(prefix + "_bucket")
+            ]
+            count = int(
+                [
+                    line
+                    for line in metrics.splitlines()
+                    if line.startswith(prefix + "_count")
+                ][0].rsplit(" ", 1)[1]
+            )
+            assert buckets == sorted(buckets), "buckets not cumulative"
+            assert buckets[-1] == count >= 1, (buckets, count)
+
+            tracez = get("/debug/tracez")
+            assert trace_id in tracez, tracez
+            for span in ("decode", "service.should_rate_limit", "kernel.step"):
+                assert span in tracez, (span, tracez)
+
+            print(
+                json.dumps(
+                    {
+                        "metrics_smoke": "ok",
+                        "response_count": count,
+                        "trace_id": trace_id,
+                    }
+                )
+            )
+            return 0
+        finally:
+            runner.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,33 @@
+"""Seeded timing-discipline violations (wall clock in durations)."""
+
+import time
+
+
+def elapsed_direct(start):
+    return time.time() - start  # line 7: direct call in subtraction
+
+
+def elapsed_via_names(work):
+    t0 = time.time()
+    work()
+    t1 = time.time()
+    return t1 - t0  # line 14: both names bound from time.time()
+
+
+def deadline_remaining(deadline):
+    return deadline - time.time()  # line 18: right operand
+
+
+def ok_monotonic(work):
+    t0 = time.monotonic()
+    work()
+    return time.monotonic() - t0  # clean: monotonic
+
+
+def ok_wall_stamp():
+    saved_at = time.time()  # clean: storing a timestamp
+    return {"saved_at": saved_at}
+
+
+def ok_wall_addition():
+    return time.time() + 5  # clean: building a deadline stamp

@@ -17,6 +17,7 @@ from ratelimit_tpu.analysis.rules import (
     EnvDisciplineRule,
     JaxHostSyncRule,
     LockDisciplineRule,
+    TimingDisciplineRule,
     _make_default_rules,
 )
 
@@ -65,6 +66,42 @@ def test_env_rule_fires_on_seeded_violations():
 def test_dtype_rule_fires_on_seeded_violations():
     findings = lint(FIXTURES / "ops" / "dtype_violation.py")
     assert lines_for(findings, "dtype-discipline") == [8, 9, 10]
+
+
+def test_timing_rule_fires_on_seeded_violations():
+    findings = lint(FIXTURES / "timing_violation.py")
+    # direct-call subtraction, name-bound subtraction, wall clock as
+    # the right operand — and nothing else (monotonic durations, wall
+    # stamps, and deadline ADDITION stay quiet).
+    assert lines_for(findings, "timing-discipline") == [7, 14, 18]
+    assert all(f.rule_id == "timing-discipline" for f in findings)
+
+
+def test_timing_rule_handles_from_time_import_time():
+    """`from time import time` makes the bare call wall-clock."""
+    engine = AnalysisEngine([TimingDisciplineRule()])
+    src = (
+        "from time import time\n"
+        "def f(t0):\n"
+        "    return time() - t0\n"
+    )
+    assert [f.line for f in engine.check_source("pkg/mod.py", src)] == [3]
+
+
+def test_timing_rule_wall_names_are_scope_local():
+    """A nested function's wall-bound name must not poison the outer
+    scope (and vice versa)."""
+    engine = AnalysisEngine([TimingDisciplineRule()])
+    src = (
+        "import time\n"
+        "def outer(a, b):\n"
+        "    def inner():\n"
+        "        t = time.time()\n"
+        "        return t\n"
+        "    t = a\n"
+        "    return t - b\n"  # outer's t is NOT wall clock
+    )
+    assert engine.check_source("pkg/mod.py", src) == []
 
 
 def test_dtype_rule_is_scoped_to_kernel_packages(tmp_path):
@@ -198,6 +235,7 @@ def test_cli_list_rules():
         "lock-discipline",
         "env-discipline",
         "dtype-discipline",
+        "timing-discipline",
     ):
         assert rule_id in proc.stdout
 

@@ -1,0 +1,360 @@
+"""Unit coverage for ratelimit_tpu/observability/ + the stats-layer
+additions that back it: tracer sampling/commit policy, W3C traceparent
+parse/inject, the trace ring, exporters, tracez rendering, Histogram
+bucket/quantile math, golden Prometheus exposition text, Timer sample
+drop accounting, and statsd socket lifecycle."""
+
+import json
+import socket
+import threading
+
+import pytest
+
+from ratelimit_tpu.observability import (
+    JsonlExporter,
+    Tracer,
+    format_traceparent,
+    parse_traceparent,
+)
+from ratelimit_tpu.observability import prometheus, tracez
+from ratelimit_tpu.stats.manager import Histogram, StatsStore, Timer
+from ratelimit_tpu.stats.statsd import StatsdExporter
+
+
+# -- traceparent -------------------------------------------------------------
+
+
+def test_traceparent_roundtrip():
+    header = format_traceparent("ab" * 16, "cd" * 8, True)
+    assert header == f"00-{'ab' * 16}-{'cd' * 8}-01"
+    ctx = parse_traceparent(header)
+    assert ctx.trace_id == "ab" * 16
+    assert ctx.span_id == "cd" * 8
+    assert ctx.sampled is True
+    assert parse_traceparent(format_traceparent("ab" * 16, "cd" * 8, False)).sampled is False
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        None,
+        "",
+        "garbage",
+        "00-zz" + "a" * 30 + "-" + "b" * 16 + "-01",  # non-hex
+        "00-" + "a" * 31 + "-" + "b" * 16 + "-01",  # short trace id
+        "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",  # forbidden version
+        "00-" + "0" * 32 + "-" + "b" * 16 + "-01",  # all-zero trace id
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # all-zero span id
+    ],
+)
+def test_traceparent_malformed_is_none(bad):
+    assert parse_traceparent(bad) is None
+
+
+# -- tracer sampling + commit policy ----------------------------------------
+
+
+def _one_trace(tracer, status="ok", traceparent=None):
+    root = tracer.start_span("root", traceparent)
+    with root:
+        with tracer.span("child"):
+            pass
+        if status != "ok":
+            root.set_status(status)
+    return root
+
+
+def test_head_sampled_trace_commits_with_span_tree():
+    tracer = Tracer(sample_rate=1.0)
+    _one_trace(tracer)
+    (t,) = tracer.recent()
+    assert t.root_name == "root"
+    assert [s["name"] for s in t.spans] == ["child", "root"]
+    child, root = t.spans
+    assert child["parent_id"] == root["span_id"]
+    assert root["parent_id"] == ""
+
+
+def test_unsampled_clean_trace_is_dropped_but_errors_commit():
+    tracer = Tracer(sample_rate=0.0, sample_errors=True)
+    _one_trace(tracer)  # clean: recorded then dropped at commit
+    assert tracer.recent() == []
+    _one_trace(tracer, status="error")
+    _one_trace(tracer, status="over_limit")
+    assert [t.status for t in tracer.recent()] == ["error", "over_limit"]
+
+
+def test_disabled_tracer_returns_noop_everywhere():
+    tracer = Tracer(enabled=False)
+    root = tracer.start_span("root")
+    assert root.recording is False
+    with root:
+        assert tracer.span("child").recording is False
+        assert tracer.current() is None
+    assert tracer.recent() == []
+
+
+def test_inbound_sampled_flag_forces_commit():
+    tracer = Tracer(sample_rate=0.0, sample_errors=False)
+    header = format_traceparent("ab" * 16, "cd" * 8, True)
+    _one_trace(tracer, traceparent=header)
+    (t,) = tracer.recent()
+    assert t.trace_id == "ab" * 16
+    assert t.parent_id == "cd" * 8  # upstream span is our root's parent
+    assert t.spans[-1]["parent_id"] == "cd" * 8
+
+
+def test_inbound_unsampled_flag_does_not_force():
+    tracer = Tracer(sample_rate=0.0, sample_errors=False)
+    header = format_traceparent("ab" * 16, "cd" * 8, False)
+    _one_trace(tracer, traceparent=header)
+    assert tracer.recent() == []
+
+
+def test_exception_marks_root_error_and_propagates():
+    tracer = Tracer(sample_rate=1.0)
+    with pytest.raises(ValueError):
+        with tracer.start_span("root"):
+            raise ValueError("boom")
+    (t,) = tracer.recent()
+    assert t.status == "error"
+    assert "boom" in t.detail
+
+
+def test_ring_is_bounded_and_slowest_kept():
+    tracer = Tracer(sample_rate=1.0, ring_size=4, slow_size=2)
+    for _ in range(10):
+        _one_trace(tracer)
+    assert len(tracer.recent()) == 4
+    slow = tracer.slowest()
+    assert len(slow) == 2
+    assert slow[0].duration_ms >= slow[1].duration_ms
+
+
+def test_record_span_from_stamps_cross_thread():
+    """The dispatcher seam: stamps taken on another thread become
+    spans on the handler thread after the join."""
+    tracer = Tracer(sample_rate=1.0)
+    stamps = {}
+
+    def dispatcher_side():
+        import time
+
+        stamps["launch"] = time.perf_counter()
+        stamps["complete"] = stamps["launch"] + 0.002
+
+    root = tracer.start_span("root")
+    with root:
+        t = threading.Thread(target=dispatcher_side)
+        t.start()
+        t.join()
+        tracer.record_span(
+            "kernel.step",
+            stamps["launch"],
+            stamps["complete"],
+            attrs={"lanes": 8},
+            parent=root,
+        )
+    (trace,) = tracer.recent()
+    kernel = [s for s in trace.spans if s["name"] == "kernel.step"]
+    assert len(kernel) == 1
+    assert kernel[0]["duration_ms"] == pytest.approx(2.0, rel=0.01)
+    assert kernel[0]["attrs"] == {"lanes": 8}
+
+
+def test_traceparent_outbound_continues_trace():
+    tracer = Tracer(sample_rate=1.0)
+    root = tracer.start_span("root")
+    with root:
+        out = root.traceparent()
+    ctx = parse_traceparent(out)
+    assert ctx.trace_id == root.trace_id
+    assert ctx.span_id == root.span_id
+    assert ctx.sampled is True
+
+
+def test_jsonl_exporter_writes_one_line_per_trace(tmp_path):
+    path = tmp_path / "traces.jsonl"
+    tracer = Tracer(sample_rate=1.0)
+    exporter = JsonlExporter(str(path))
+    tracer.add_exporter(exporter)
+    _one_trace(tracer)
+    _one_trace(tracer, status="over_limit")
+    exporter.close()
+    lines = path.read_text().strip().splitlines()
+    assert len(lines) == 2
+    first = json.loads(lines[0])
+    assert first["root"] == "root"
+    assert [s["name"] for s in first["spans"]] == ["child", "root"]
+
+
+def test_tracez_renders_span_tree_and_trace_id():
+    tracer = Tracer(sample_rate=1.0)
+    header = format_traceparent("ab" * 16, "cd" * 8, True)
+    _one_trace(tracer, traceparent=header)
+    text = tracez.render(tracer)
+    assert "ab" * 16 in text
+    assert "--- slowest" in text and "--- most recent" in text
+    # Child is indented under root.
+    root_line = [l for l in text.splitlines() if l.strip().startswith("root")][0]
+    child_line = [l for l in text.splitlines() if l.strip().startswith("child")][0]
+    assert len(child_line) - len(child_line.lstrip()) > len(root_line) - len(
+        root_line.lstrip()
+    )
+
+
+# -- histogram ---------------------------------------------------------------
+
+
+def test_histogram_buckets_and_counts():
+    h = Histogram("h", bounds=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 3.0, 100.0):
+        h.observe(v)
+    bounds, counts, total_sum, count = h.snapshot()
+    assert bounds == (1.0, 2.0, 4.0)
+    assert counts == [1, 1, 1, 1]  # last cell = overflow
+    assert count == 4
+    assert total_sum == pytest.approx(105.0)
+
+
+def test_histogram_quantiles_interpolate():
+    h = Histogram("h", bounds=(10.0, 20.0, 40.0))
+    for _ in range(100):
+        h.observe(15.0)  # all in (10, 20]
+    s = h.summary()
+    # Interpolation inside the (10,20] bucket: p50 at half the bucket.
+    assert s["p50_ms"] == pytest.approx(15.0)
+    assert s["p99_ms"] == pytest.approx(19.9)
+    assert s["count"] == 100
+    assert s["max_ms"] == 15.0
+
+
+def test_histogram_empty_summary_is_zero():
+    s = Histogram("h").summary()
+    assert s["count"] == 0
+    assert s["p99_ms"] == 0.0
+
+
+def test_histogram_overflow_quantile_clamps_to_last_bound():
+    h = Histogram("h", bounds=(1.0, 2.0))
+    for _ in range(10):
+        h.observe(50.0)
+    assert h.summary()["p50_ms"] == 2.0
+
+
+def test_store_histogram_is_idempotent_and_listed():
+    store = StatsStore()
+    a = store.histogram("x.latency_ms")
+    b = store.histogram("x.latency_ms")
+    assert a is b
+    assert store.histogram_names() == ["x.latency_ms"]
+    a.observe(3.0)
+    assert store.histograms()["x.latency_ms"]["count"] == 1
+
+
+# -- prometheus exposition (golden) ------------------------------------------
+
+
+def test_prometheus_exposition_golden():
+    store = StatsStore()
+    store.counter("ratelimit.service.config_load_success").add(3)
+    store.gauge("ratelimit.tpu.bank0.live_keys").set(7)
+    h = store.histogram("server.response_ms", bounds=(0.5, 1.0, 2.0))
+    for v in (0.25, 0.75, 5.0):
+        h.observe(v)
+    golden = (
+        "# TYPE ratelimit_service_config_load_success counter\n"
+        "ratelimit_service_config_load_success 3\n"
+        "# TYPE ratelimit_tpu_bank0_live_keys gauge\n"
+        "ratelimit_tpu_bank0_live_keys 7\n"
+        "# TYPE server_response_ms histogram\n"
+        'server_response_ms_bucket{le="0.5"} 1\n'
+        'server_response_ms_bucket{le="1"} 2\n'
+        'server_response_ms_bucket{le="2"} 2\n'
+        'server_response_ms_bucket{le="+Inf"} 3\n'
+        "server_response_ms_sum 6\n"
+        "server_response_ms_count 3\n"
+    )
+    assert prometheus.render(store) == golden
+
+
+def test_prometheus_bucket_cumulativity_and_count_consistency():
+    store = StatsStore()
+    h = store.histogram("h_ms")
+    for v in (0.1, 1.0, 10.0, 100.0, 100000.0):
+        h.observe(v)
+    text = prometheus.render(store)
+    bucket_counts = [
+        int(line.rsplit(" ", 1)[1])
+        for line in text.splitlines()
+        if line.startswith("h_ms_bucket")
+    ]
+    assert bucket_counts == sorted(bucket_counts)  # cumulative
+    assert bucket_counts[-1] == 5  # +Inf == _count
+    assert "h_ms_count 5" in text
+
+
+def test_prometheus_name_sanitization():
+    assert prometheus.metric_name("a.b-c.d") == "a_b_c_d"
+    assert prometheus.metric_name("9lives") == "_9lives"
+    store = StatsStore()
+    store.counter("ratelimit.__tag=value.total").inc()
+    text = prometheus.render(store)
+    assert "ratelimit___tag_value_total 1" in text
+
+
+# -- timer sample drops (satellite) ------------------------------------------
+
+
+def test_timer_counts_dropped_samples():
+    t = Timer("t")
+    for i in range(Timer.MAX_SAMPLES + 7):
+        t.add_duration_ms(1.0)
+    s = t.summary()
+    assert s["count"] == Timer.MAX_SAMPLES + 7
+    assert s["samples_dropped"] == 7
+    assert len(t.drain_samples()) == Timer.MAX_SAMPLES
+    assert t.drain_dropped() == 7
+    assert t.drain_dropped() == 0  # delta semantics
+    # Cumulative view survives the drain.
+    assert t.summary()["samples_dropped"] == 7
+
+
+def test_statsd_flush_emits_dropped_counter():
+    store = StatsStore()
+    t = store.timer("x.response_time")
+    for _ in range(Timer.MAX_SAMPLES + 3):
+        t.add_duration_ms(1.0)
+    received = []
+    server = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    server.bind(("127.0.0.1", 0))
+    server.settimeout(5)
+    exporter = StatsdExporter(store, "127.0.0.1", server.getsockname()[1])
+    try:
+        exporter.flush()
+        while True:
+            try:
+                server.settimeout(0.5)
+                received.append(server.recv(65536).decode())
+            except socket.timeout:
+                break
+        payload = "\n".join(received)
+        assert "x.response_time.timer_samples_dropped:3|c" in payload
+    finally:
+        exporter.stop()
+        server.close()
+
+
+# -- statsd socket lifecycle (satellite) -------------------------------------
+
+
+def test_statsd_stop_closes_socket_and_flush_becomes_noop():
+    store = StatsStore()
+    store.counter("c").inc()
+    exporter = StatsdExporter(store, "127.0.0.1", 9)  # discard port
+    sock = exporter._sock
+    exporter.start()
+    exporter.stop()
+    assert sock.fileno() == -1  # closed
+    exporter.flush()  # must not raise on the closed socket
+    exporter.stop()  # idempotent

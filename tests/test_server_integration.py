@@ -70,7 +70,7 @@ def runner(tmp_path_factory):
     r.stop()
 
 
-def _grpc_call(runner, request_pb):
+def _grpc_call(runner, request_pb, metadata=None):
     with grpc.insecure_channel(
         f"127.0.0.1:{runner.grpc_server.bound_port}"
     ) as channel:
@@ -79,7 +79,7 @@ def _grpc_call(runner, request_pb):
             request_serializer=rls_pb2.RateLimitRequest.SerializeToString,
             response_deserializer=rls_pb2.RateLimitResponse.FromString,
         )
-        return method(request_pb, timeout=30)
+        return method(request_pb, timeout=30, metadata=metadata)
 
 
 def _request(domain, entries, hits=0):
@@ -521,6 +521,146 @@ def test_per_second_bank_wired_through_runner(tmp_path_factory):
         assert "ratelimit.tpu.bank1.live_keys: 1" in text
     finally:
         r.stop()
+
+
+def test_traceparent_roundtrip_grpc_phase_spans(runner):
+    """Observability acceptance: a gRPC request carrying a W3C
+    traceparent (sampled) produces a committed trace under the SAME
+    trace id with the full phase breakdown — decode, service, backend
+    dispatch, kernel — and that trace renders in /debug/tracez."""
+    from ratelimit_tpu.observability import TRACER
+
+    trace_id = "1f" * 16
+    parent_span = "2e" * 8
+    header = f"00-{trace_id}-{parent_span}-01"
+    resp = _grpc_call(
+        runner,
+        _request("basic", [("key1", "traceme")]),
+        metadata=[("traceparent", header)],
+    )
+    assert resp.overall_code == rls_pb2.RateLimitResponse.OK
+
+    match = [t for t in TRACER.recent() if t.trace_id == trace_id]
+    assert match, "inbound traceparent's trace id not in the ring"
+    trace = match[-1]
+    assert trace.parent_id == parent_span
+    names = {s["name"] for s in trace.spans}
+    # >= 4 phase spans, kernel leg included (the request hit the
+    # engine through the dispatcher).
+    assert {
+        "decode",
+        "service.should_rate_limit",
+        "backend.do_limit",
+        "backend.dispatch",
+        "kernel.step",
+    } <= names
+    root = [s for s in trace.spans if s["name"] == "grpc.should_rate_limit"]
+    assert root and root[0]["parent_id"] == parent_span
+
+    # The kernel span sits inside the backend.do_limit span's window.
+    by_name = {s["name"]: s for s in trace.spans}
+    backend = by_name["backend.do_limit"]
+    kernel = by_name["kernel.step"]
+    assert kernel["start_ms"] >= backend["start_ms"]
+    assert kernel["attrs"]["lanes"] >= 1
+
+    # /debug/tracez shows the trace by id with its span tree.
+    status, out = _http(
+        runner, "/debug/tracez", port=runner.debug_server.bound_port
+    )
+    assert status == 200
+    text = out.decode()
+    assert trace_id in text
+    assert "kernel.step" in text
+
+
+def test_traceparent_roundtrip_http_json(runner):
+    """The /json bridge: inbound traceparent header adopts the trace,
+    and the response echoes a traceparent continuing the SAME trace."""
+    from ratelimit_tpu.observability import TRACER
+
+    trace_id = "3d" * 16
+    header = f"00-{trace_id}-{'4c' * 8}-01"
+    body = json.dumps(
+        {
+            "domain": "basic",
+            "descriptors": [{"entries": [{"key": "key1", "value": "httptrace"}]}],
+        }
+    ).encode()
+    url = f"http://127.0.0.1:{runner.http_server.bound_port}/json"
+    req = urllib.request.Request(url, data=body)
+    req.add_header("traceparent", header)
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.status == 200
+        echoed = resp.headers.get("traceparent")
+    assert echoed is not None and echoed.split("-")[1] == trace_id
+    assert any(t.trace_id == trace_id for t in TRACER.recent())
+
+
+def test_metrics_endpoint_serves_phase_histograms(runner):
+    """GET /metrics: valid Prometheus text with per-phase histogram
+    buckets — cumulative, +Inf == _count — from which p99 is
+    derivable."""
+    # Ensure at least one request has been observed.
+    _grpc_call(runner, _request("basic", [("key1", "metricsprobe")]))
+    status, out = _http(runner, "/metrics", port=runner.debug_server.bound_port)
+    assert status == 200
+    text = out.decode()
+    for phase in ("decode", "service", "serialize"):
+        assert (
+            f"# TYPE ratelimit_server_ShouldRateLimit_phase_{phase}_ms "
+            "histogram" in text
+        )
+    prefix = "ratelimit_server_ShouldRateLimit_response_ms"
+    bucket_lines = [
+        l for l in text.splitlines() if l.startswith(prefix + "_bucket")
+    ]
+    assert bucket_lines, text
+    counts = [int(l.rsplit(" ", 1)[1]) for l in bucket_lines]
+    assert counts == sorted(counts)  # cumulative buckets
+    count_line = [
+        l for l in text.splitlines() if l.startswith(prefix + "_count")
+    ][0]
+    total = int(count_line.rsplit(" ", 1)[1])
+    assert total >= 1
+    assert counts[-1] == total  # +Inf bucket equals _count
+    # p99 derivable: find the first bucket holding the 0.99 rank.
+    import re as _re
+
+    rank = 0.99 * total
+    for line, cum in zip(bucket_lines, counts):
+        if cum >= rank:
+            le = _re.search(r'le="([^"]+)"', line).group(1)
+            assert le == "+Inf" or float(le) > 0
+            break
+    else:
+        pytest.fail("no bucket covers the p99 rank")
+    # Counters and gauges are present too.
+    assert "ratelimit_server_ShouldRateLimit_total_requests" in text
+    assert "ratelimit_tpu_bank0_live_keys" in text
+
+
+def test_unsampled_requests_stay_out_of_the_ring(runner):
+    """No traceparent, sample_rate 0: a clean request must not commit
+    a trace (the error/over-limit override stays for bad ones)."""
+    from ratelimit_tpu.observability import TRACER
+
+    before = len(TRACER.recent())
+    resp = _grpc_call(runner, _request("basic", [("nosuch", "quiet")]))
+    assert resp.overall_code == rls_pb2.RateLimitResponse.OK
+    assert len(TRACER.recent()) == before
+
+
+def test_over_limit_commits_trace_without_sampling(runner):
+    """Tail-sampling override: an OVER_LIMIT decision commits even
+    with no traceparent and rate 0."""
+    from ratelimit_tpu.observability import TRACER
+
+    req = _request("basic", [("one_per_minute", "something")])
+    codes = {_grpc_call(runner, req).overall_code for _ in range(3)}
+    assert rls_pb2.RateLimitResponse.OVER_LIMIT in codes
+    over = [t for t in TRACER.recent() if t.status == "over_limit"]
+    assert over, [t.status for t in TRACER.recent()]
 
 
 def test_window_rollover_and_decay_over_the_wire(runner):
