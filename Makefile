@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: all test lint bench protos native serve check_config smoke_client metrics-smoke docker_image e2e e2e-local ci clean
+.PHONY: all test lint bench bench-host protos native serve check_config smoke_client metrics-smoke docker_image e2e e2e-local ci clean
 
 # C++ hot-path library: slot table + decide kernel (auto-built on
 # first import too; this forces it).  Goes through the Python builder
@@ -30,6 +30,13 @@ lint:
 # Headline benchmark on the default JAX device (real chip under axon).
 bench:
 	$(PY) bench.py
+
+# Host-path smoke: quick-mode profile_host_path.py asserting the
+# descriptor-resolution cache reports a nonzero hit rate after warmup
+# and the fast path stays engaged (no misses once warm) —
+# docs/HOST_PATH.md.  Pure host work; no device step.
+bench-host:
+	$(CPU_ENV) $(PY) benchmarks/profile_host_path.py --quick
 
 # Regenerate committed protobuf classes after editing protos/.
 protos:
@@ -77,7 +84,7 @@ e2e-local:
 # The full CI recipe (.github/workflows/ci.yaml runs exactly this):
 # native build, tests, offline config validation, black-box e2e,
 # bench smoke on the CPU platform.
-ci: lint native test check_config metrics-smoke e2e-local
+ci: lint native test check_config metrics-smoke bench-host e2e-local
 	$(CPU_ENV) PALLAS_AXON_POOL_IPS= $(PY) bench.py
 
 clean:

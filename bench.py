@@ -32,11 +32,15 @@ End-to-end serving numbers (RPC -> dispatcher -> device -> response,
 which on this harness include the tunnel) are reported separately by
 benchmarks/sweep.py.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Prints the result as the FINAL JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "platform": ...}
 vs_baseline is against BASELINE.json's north-star target of 50M
 descriptor decisions/sec/chip (the reference publishes no numbers of
-its own — see BASELINE.md).
+its own — see BASELINE.md).  Device discovery is probed in a
+subprocess under a hard timeout (BENCH_DISCOVERY_TIMEOUT_S, default
+120 s); if the probe hangs or fails (axon tunnel down) the bench pins
+JAX_PLATFORMS=cpu, notes "platform": "cpu_fallback", and still exits 0
+— a slow CPU number beats a lost round.
 """
 
 from __future__ import annotations
@@ -54,14 +58,70 @@ CALLS = 128
 LIMIT_MAX = 1000
 
 
+def _bound_device_discovery() -> str:
+    """Bound device discovery with a hard timeout and fall back to the
+    CPU platform instead of hanging.
+
+    With the axon tunnel down, jax.devices() HANGS rather than erroring
+    — and a hung bench loses its whole round (BENCH_r04/r05 each burned
+    >180 s before the old in-process watchdog could only exit non-zero).
+    Discovery can't be interrupted in-process once jax has started it,
+    so probe it in a SUBPROCESS under a kill-able timeout; on timeout or
+    failure, pin JAX_PLATFORMS=cpu in THIS process before jax is
+    imported and report the fallback in the result record.  The bench
+    then still emits a parseable line and exits 0 — a slow CPU number
+    beats a lost round.
+
+    Returns the platform tag for the result record: "default",
+    "pinned:<env>", or "cpu_fallback".
+    """
+    import os
+    import subprocess
+    import sys
+
+    pinned = os.environ.get("JAX_PLATFORMS", "")
+    if pinned:
+        return f"pinned:{pinned}"
+    timeout_s = float(os.environ.get("BENCH_DISCOVERY_TIMEOUT_S", "120"))
+    try:
+        rc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            timeout=timeout_s,
+        ).returncode
+    except subprocess.TimeoutExpired:
+        rc = -1
+    if rc != 0:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        print(
+            json.dumps(
+                {
+                    "event": "device_discovery_fallback",
+                    "reason": (
+                        f"device discovery probe failed (rc={rc}, "
+                        f"timeout={timeout_s:.0f}s; tunnel down?); "
+                        "falling back to JAX_PLATFORMS=cpu"
+                    ),
+                }
+            ),
+            flush=True,
+        )
+        return "cpu_fallback"
+    return "default"
+
+
 def main() -> None:
-    # Device-discovery watchdog: with the axon tunnel down,
-    # jax.devices() HANGS instead of erroring — a hung bench is worse
-    # than a failed one (the driver can at least record a failure
-    # line).  Disarmed the moment discovery returns.
     import os
     import threading
 
+    platform = _bound_device_discovery()
+
+    # Belt-and-suspenders watchdog for the in-process import: even
+    # after a successful probe the tunnel can die between the probe
+    # and the real discovery.  Emits the parseable record and exits 0
+    # (a recorded failure line, not a lost round).  Disarmed the
+    # moment discovery returns.
     armed = threading.Event()
     armed.set()
 
@@ -77,12 +137,13 @@ def main() -> None:
                         "value": 0,
                         "unit": "decisions/s/chip",
                         "vs_baseline": 0,
+                        "platform": platform,
                         "error": "device discovery hung >180s (tunnel down?)",
                     }
                 ),
                 flush=True,
             )
-            os._exit(3)
+            os._exit(0)
 
     threading.Thread(target=watchdog, daemon=True).start()
 
@@ -188,6 +249,7 @@ def main() -> None:
                 "vs_baseline": round(
                     decisions_per_sec / BASELINE_DECISIONS_PER_SEC, 4
                 ),
+                "platform": platform,
             }
         )
     )

@@ -14,8 +14,21 @@ Phases of the round-3 packed pipeline:
   completer   : readback -> vectorized decide -> tolist -> per-item
                 status assembly
 
+Round-6 addition: the descriptor-resolution front half (rule lookup +
+key generation + routing + lane packing) measured through the REAL
+service/cache seams (service._construct_limits_to_check +
+tpu_cache._prepare), warm, with the resolution cache on vs off — the
+cost the one-dict-hit fast path (limiter/resolution.py) attacks.
+
 Run:  JAX_PLATFORMS=cpu python benchmarks/profile_host_path.py
 Writes benchmarks/results/host_path.json.
+
+Quick mode (CI smoke, `make bench-host`):
+      JAX_PLATFORMS=cpu python benchmarks/profile_host_path.py --quick
+runs only the resolution section with few iterations, asserts the
+resolution cache reports a nonzero hit rate after warmup and that the
+fast path STAYS engaged (no misses during the measured phase), prints
+one JSON line, and exits non-zero on violation.  Writes no artifact.
 """
 
 from __future__ import annotations
@@ -83,9 +96,143 @@ def timed(fn, *args, reps=ITERS):
     return float(np.median(arr)), out
 
 
+def profile_resolution(results, quick: bool = False):
+    """Serving front half (rule lookup + key gen + routing + packing),
+    resolved vs uncached, through the real seams.  Returns (ok, info):
+    ok is the quick-mode assertion verdict (cache engaged + fast path
+    stays engaged)."""
+    from ratelimit_tpu.api import Descriptor, RateLimitRequest  # noqa: E402
+    from ratelimit_tpu.backends.tpu_cache import TpuRateLimitCache  # noqa: E402
+    from ratelimit_tpu.service import RateLimitService  # noqa: E402
+    from ratelimit_tpu.stats.manager import Manager  # noqa: E402
+    from ratelimit_tpu.utils.time import PinnedTimeSource  # noqa: E402
+
+    n_reqs = 128 if quick else REQUESTS
+    reps = 6 if quick else ITERS
+    yaml = (
+        "domain: domain\n"
+        "descriptors:\n"
+        "  - key: key\n"
+        "    rate_limit:\n"
+        "      unit: hour\n"
+        "      requests_per_unit: 1000\n"
+    )
+
+    class _Runtime:
+        def __init__(self, files):
+            self._files = files
+
+        def snapshot(self):
+            files = self._files
+
+            class Snap:
+                def keys(self):
+                    return sorted(files)
+
+                def get(self, key):
+                    return files.get(key, "")
+
+            return Snap()
+
+        def add_update_callback(self, fn):
+            pass
+
+    import gc
+
+    gc.collect()  # don't time other sections' garbage
+
+    def build(resolution_entries):
+        clock = PinnedTimeSource(1_700_000_000)
+        # No device work happens in _prepare, so a small engine is fine.
+        engine = CounterEngine(num_slots=1 << 16)
+        cache = TpuRateLimitCache(
+            engine, clock, resolution_cache_entries=resolution_entries
+        )
+        svc = RateLimitService(
+            _Runtime({"config.bench": yaml}), cache, Manager(), clock=clock
+        )
+        return svc, cache
+
+    rng = np.random.default_rng(7)
+    key_ids = rng.integers(0, DUP_KEYS, n_reqs * 4)
+    reqs = []
+    for r in range(n_reqs):
+        descs = [
+            Descriptor.of(("key", f"value{key_ids[r * 4 + j]}"))
+            for j in range(4)
+        ]
+        reqs.append(RateLimitRequest("domain", descs, 0))
+
+    def front_fast(svc, cache):
+        # The fused one-pass front half (service hot path: rule lookup
+        # + keys + routing + packing in do_limit_resolved's _prepare_
+        # resolved).  Recycle the WorkItem events the way _execute does
+        # after its waits (steady-state serving keeps the pool warm;
+        # the front half alone never reaches that code).
+        pool = cache._event_pool
+        config = svc.get_current_config()
+        for req in reqs:
+            items, *_ = cache._prepare_resolved(req, config)
+            if len(pool) < 1024:
+                for _bank, _eng, item in items:
+                    pool.append(item.event)
+
+    def front_uncached(svc, cache):
+        pool = cache._event_pool
+        for req in reqs:
+            limits, _unl = svc._construct_limits_to_check(req)
+            items, *_ = cache._prepare(req, limits)
+            if len(pool) < 1024:
+                for _bank, _eng, item in items:
+                    pool.append(item.event)
+
+    svc_fast, cache_fast = build(1 << 16)
+    svc_slow, cache_slow = build(0)
+
+    front_fast(svc_fast, cache_fast)  # warm: populate the cache
+    front_uncached(svc_slow, cache_slow)
+    misses_after_warmup = cache_fast.resolver.misses
+    t_fast, _ = timed(front_fast, svc_fast, cache_fast, reps=reps)
+    t_slow, _ = timed(front_uncached, svc_slow, cache_slow, reps=reps)
+    res = cache_fast.resolver
+
+    scale = REQUESTS / n_reqs  # report per-1024-request batch
+    results["resolution_uncached_per_batch"] = t_slow * scale
+    results["resolution_resolved_per_batch"] = t_fast * scale
+    results["resolution_speedup"] = t_slow / t_fast if t_fast else 0.0
+    results["resolution_cache_hits"] = res.hits
+    results["resolution_cache_misses"] = res.misses
+
+    hit_rate = res.hits / max(1, res.hits + res.misses)
+    stayed_engaged = res.misses == misses_after_warmup
+    ok = hit_rate > 0.5 and stayed_engaged
+    info = {
+        "requests": n_reqs,
+        "uncached_us_per_req": t_slow / n_reqs * 1e6,
+        "resolved_us_per_req": t_fast / n_reqs * 1e6,
+        "speedup": results["resolution_speedup"],
+        "hits": res.hits,
+        "misses": res.misses,
+        "hit_rate": hit_rate,
+        "fast_path_stayed_engaged": stayed_engaged,
+    }
+    return ok, info
+
+
 def main():
+    if "--quick" in sys.argv:
+        results = {}
+        ok, info = profile_resolution(results, quick=True)
+        print(json.dumps({"quick": True, "ok": ok, **info}))
+        sys.exit(0 if ok else 1)
+
     engine = CounterEngine(num_slots=1 << 20)
     results = {}
+
+    # Round-6: the descriptor-resolution front half, resolved vs
+    # uncached, through the real service/cache seams.  Runs FIRST so
+    # the dispatcher sections' allocation churn can't contaminate it.
+    _, res_info = profile_resolution(results)
 
     # Warm the XLA shapes first.
     items = make_items(engine, 0)
@@ -260,10 +407,13 @@ def main():
             "round-4 pipeline: LanePack on RPC threads, fused C++ "
             "assign+dedup, single (4,N) int32 transfer, fused C++ "
             "decide+reconstruct (native/decide.cpp), deferred status "
-            "assembly on RPC threads (defer_apply); 1-core host, CPU "
-            "platform"
+            "assembly on RPC threads (defer_apply); round-6: "
+            "descriptor-resolution cache front half (resolution_* "
+            "keys, per 1024-request/4096-lane batch); 1-core host, "
+            "CPU platform"
         ),
         "phases_seconds": results,
+        "resolution": res_info,
     }
     path = os.path.join(
         os.path.dirname(__file__), "results", "host_path.json"
@@ -271,7 +421,10 @@ def main():
     with open(path, "w") as f:
         json.dump(out, f, indent=2)
     for k, v in results.items():
-        print(f"{k:45s} {v*1e6:12.1f} us" if v < 1 else f"{k:45s} {v:12.3f}")
+        if isinstance(v, float) and v < 1:
+            print(f"{k:45s} {v*1e6:12.1f} us")
+        else:
+            print(f"{k:45s} {v:12.3f}" if isinstance(v, float) else f"{k:45s} {v:12d}")
     print(f"wrote {path}")
 
 

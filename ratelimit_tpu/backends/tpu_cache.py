@@ -38,8 +38,9 @@ import numpy as np
 from ..api import Code, DescriptorStatus, RateLimitRequest
 from ..config import RateLimitRule
 from ..observability import TRACER
-from ..limiter.cache_key import CacheKeyGenerator
+from ..limiter.cache_key import CacheKeyGenerator, EMPTY_KEY
 from ..limiter.local_cache import LocalCache
+from ..limiter.resolution import ResolutionCache
 from ..utils.time import (
     TimeSource,
     RealTimeSource,
@@ -80,6 +81,7 @@ class TpuRateLimitCache:
         dispatch_timeout_s: float = 120.0,
         pipeline_depth: int = 2,
         unhealthy_after: int = 3,
+        resolution_cache_entries: int = 1 << 16,
     ):
         """`engine` may be a LIST of engines: N independent host LANES,
         each with its own slot table, dispatcher thread pair, and
@@ -103,6 +105,21 @@ class TpuRateLimitCache:
         self.time_source = time_source or RealTimeSource()
         self.local_cache = local_cache
         self.key_generator = CacheKeyGenerator(cache_key_prefix)
+        # Descriptor-resolution fast path (limiter/resolution.py): the
+        # service resolves each descriptor through this once per config
+        # generation; do_limit then reuses the memoized key, lane route
+        # and LANE_DTYPE template instead of re-running the per-request
+        # pipeline.  0 disables it (A/B benchmarking knob).
+        self.resolver = (
+            ResolutionCache(
+                prefix=cache_key_prefix,
+                n_lanes=len(lanes),
+                lane_dtype=LANE_DTYPE,
+                capacity=resolution_cache_entries,
+            )
+            if resolution_cache_entries > 0
+            else None
+        )
         self.expiration_jitter_max_seconds = int(expiration_jitter_max_seconds)
         self.jitter_rand = jitter_rand or random.Random()
         # Liveness backstop for dispatcher waits; generous because the
@@ -113,6 +130,14 @@ class TpuRateLimitCache:
         # The reference wraps its jitter rand in a mutex because
         # rand.Rand isn't goroutine-safe (utils/time.go:28-48); same.
         self._jitter_lock = threading.Lock()
+        # Recycled WorkItem events (threading.Event construction is
+        # ~1.8us — the single largest fixed cost of an all-resolved
+        # request).  Plain list: append/pop are GIL-atomic.  Events
+        # are recycled ONLY after a successful wait() (the completer's
+        # set() has a happens-before edge to the waiter and never
+        # touches the event again); timed-out/failed items keep
+        # theirs, so a late set() can't leak into a new item.
+        self._event_pool: List[threading.Event] = []
 
         # Inline mode (batch_window_us=0) runs the engine step on the
         # RPC caller thread; a per-engine lock serializes access to the
@@ -149,15 +174,40 @@ class TpuRateLimitCache:
 
     # -- RateLimitCache seam --------------------------------------------
 
-    def do_limit(
+    def _prepare(
         self,
         request: RateLimitRequest,
         limits: Sequence[Optional[RateLimitRule]],
-    ) -> List[DescriptorStatus]:
+    ):
+        """The host-side front half of do_limit — key generation,
+        local-cache check, bank routing, lane packing — with no device
+        work.  Split out so benchmarks/profile_host_path.py can time
+        exactly this leg (the cost the resolution fast path attacks);
+        do_limit runs it then submits/waits.
+
+        Returns (items, statuses, categories, keys, hits_addend, now)
+        where items is [(bank, engine, WorkItem)]."""
         n = len(request.descriptors)
         assert n == len(limits)
         hits_addend = max(1, request.hits_addend)
         now = self.time_source.unix_now()
+
+        # Plain list: serving requests are a handful of descriptors,
+        # where list ops beat numpy scalar writes by ~10x.
+        categories = [_CAT_NONE] * n
+        n_lanes = len(self.lanes)
+        # Index lists per engine bank: one per lane, plus per-second.
+        rows_by_lane: List[List[int]] = [[] for _ in range(n_lanes)]
+        per_second_rows: List[int] = []
+        # Pre-encoded keys (lane routing hashes the utf-8 STEM so a
+        # key keeps its lane across windows and the cached/uncached
+        # paths agree); only materialized on the multi-lane path so
+        # single-lane serving pays nothing — _make_item re-encodes
+        # there as before.
+        enc_keys: Optional[List[Optional[bytes]]] = (
+            [None] * n if n_lanes > 1 else None
+        )
+        local_cache = self.local_cache
 
         # Key generation + TotalHits (base_limiter.go:45-60).
         keys = []
@@ -167,24 +217,12 @@ class TpuRateLimitCache:
             if rule is not None and not rule.unlimited:
                 rule.stats.total_hits.add(hits_addend)
 
-        categories = np.full(n, _CAT_NONE, dtype=np.int8)
-        n_lanes = len(self.lanes)
-        # Index lists per engine bank: one per lane, plus per-second.
-        rows_by_lane: List[List[int]] = [[] for _ in range(n_lanes)]
-        per_second_rows: List[int] = []
-        # Pre-encoded keys (lane routing hashes the utf-8 bytes); only
-        # materialized on the multi-lane path so single-lane serving
-        # pays nothing — _make_item re-encodes there as before.
-        enc_keys: Optional[List[Optional[bytes]]] = (
-            [None] * n if n_lanes > 1 else None
-        )
-
         for i, (key, rule) in enumerate(zip(keys, limits)):
             if key.key == "":
                 continue
-            if self.local_cache is not None and self.local_cache.contains(key.key):
-                # Shadow rules skip the counter but never short-circuit
-                # to OVER_LIMIT (fixed_cache_impl.go:57-67).
+            if local_cache is not None and local_cache.contains(key.key):
+                # Shadow rules skip the counter but never short-
+                # circuit to OVER_LIMIT (fixed_cache_impl.go:57-67).
                 categories[i] = _CAT_SKIP if rule.shadow_mode else _CAT_LOCAL
                 continue
             categories[i] = _CAT_ENGINE
@@ -195,7 +233,8 @@ class TpuRateLimitCache:
             else:
                 b = key.key.encode("utf-8")
                 enc_keys[i] = b
-                rows_by_lane[crc32(b) % n_lanes].append(i)
+                stem = b[: key.stem_blen] if key.stem_blen else b
+                rows_by_lane[crc32(stem) % n_lanes].append(i)
 
         statuses: List[Optional[DescriptorStatus]] = [None] * n
 
@@ -203,18 +242,292 @@ class TpuRateLimitCache:
             (lane, rows) for lane, rows in zip(self.lanes, rows_by_lane)
         ]
         pairs.append((self.per_second_engine, per_second_rows))
-        # When this request's trace is recording, stamp each item's
-        # dispatcher passage (submit here; launch/complete on the
-        # dispatcher threads via the WorkItem trace seam) and convert
-        # the stamps to spans after wait() — see _record_item_spans.
-        span = TRACER.current()
-        items: List[tuple] = []  # (engine, WorkItem)
+        items: List[tuple] = []  # (bank, engine, WorkItem)
         for bank, (engine, rows) in enumerate(pairs):
             if not rows:
                 continue
             item = self._make_item(
                 rows, keys, limits, hits_addend, now, statuses, enc_keys
             )
+            items.append((bank, engine, item))
+        return items, statuses, categories, keys, hits_addend, now
+
+    def _prepare_resolved(self, request: RateLimitRequest, config):
+        """The one-dict-hit front half (limiter/resolution.py): rule
+        lookup, key, TotalHits, local-cache check, bank routing AND
+        per-bank pack assembly fused into a single pass over the
+        descriptors.  Each engine-bound descriptor contributes three
+        list appends — row index, memoized key bytes, memoized
+        template record bytes — and the per-bank packer just joins
+        them.  ``_construct_limits_to_check``, CacheKeyGenerator
+        .generate and _make_item's per-lane loop all collapse here.
+
+        Returns (items, statuses, categories, keys, limits,
+        is_unlimited, hits_addend, now)."""
+        resolver = self.resolver
+        descriptors = request.descriptors
+        domain = request.domain
+        n = len(descriptors)
+        hits_addend = max(1, request.hits_addend)
+        hits_clamped = min(hits_addend, 0xFFFFFFFF)
+        now = self.time_source.unix_now()
+
+        limits: list = [None] * n
+        is_unlimited = [False] * n
+        keys: list = [EMPTY_KEY] * n
+        categories = [_CAT_NONE] * n
+        n_lanes = len(self.lanes)
+        # Per-bank accumulators: (row indices, key bytes, record bytes),
+        # lanes first, per-second bank last.  The single-bank common
+        # case routes through bound appends with no bank indirection.
+        banks = [([], [], []) for _ in range(n_lanes)]
+        ps_bank = ([], [], []) if self.per_second_engine is not None else None
+        single_bank = n_lanes == 1 and ps_bank is None
+        if single_bank:
+            rows0, enc0, tp0 = banks[0]
+            add_row = rows0.append
+            add_enc = enc0.append
+            add_tpl = tp0.append
+        local_cache = self.local_cache
+        resolve = resolver.resolve
+        # Inlined resolve() hit path: one dict probe + generation
+        # check per descriptor, with the hit tally batched into one
+        # attribute add per request.  Misses (and their counting) go
+        # through resolve() itself.
+        entries_map = resolver._entries
+        generation = config.generation
+        resolver_lanes = resolver.n_lanes
+        resolution_hits = 0
+        overrides: Optional[list] = None
+        # TotalHits adds batched by rule identity: consecutive
+        # descriptors sharing a rule (the common wildcard pattern) pay
+        # one counter lock instead of one each.
+        prev_rule = None
+        prev_hits = 0
+        for i, desc in enumerate(descriptors):
+            if desc.limit is not None:
+                # Request-supplied override: uncached leg, handled in
+                # the (rare) second pass below.
+                if overrides is None:
+                    overrides = []
+                overrides.append(i)
+                continue
+            rd = entries_map.get((domain, desc.entries))
+            if rd is not None and rd.generation == generation:
+                if rd.n_lanes != resolver_lanes:
+                    rd.rehash_lanes(resolver_lanes)
+                resolution_hits += 1
+            else:
+                rd = resolve(config, domain, desc)
+            rule = rd.rule
+            if rule is None:
+                continue  # no matching rule: CAT_NONE, empty key
+            if rd.unlimited:
+                is_unlimited[i] = True
+                continue  # limits[i] stays None (service contract)
+            limits[i] = rule
+            if rule is prev_rule:
+                prev_hits += hits_addend
+            else:
+                if prev_rule is not None:
+                    prev_rule.stats.total_hits.add(prev_hits)
+                prev_rule = rule
+                prev_hits = hits_addend
+            # Inline window-hit check (the overwhelmingly common case);
+            # window_state() handles the rollover rebuild.
+            ws = rd._win
+            if ws is None or ws.window != now - now % rd.divider:
+                ws = rd.window_state(now)
+            key = keys[i] = ws.cache_key
+            if local_cache is not None and local_cache.contains(key.key):
+                # Shadow rules skip the counter but never short-circuit
+                # to OVER_LIMIT (fixed_cache_impl.go:57-67).
+                categories[i] = _CAT_SKIP if rule.shadow_mode else _CAT_LOCAL
+                continue
+            categories[i] = _CAT_ENGINE
+            if single_bank:
+                add_row(i)
+                add_enc(ws.key_bytes)
+                add_tpl(ws.template_bytes)
+                continue
+            if ps_bank is not None and rd.per_second:
+                bank = ps_bank
+            else:
+                bank = banks[rd.lane]
+            bank[0].append(i)
+            bank[1].append(ws.key_bytes)
+            bank[2].append(ws.template_bytes)
+        if prev_rule is not None:
+            prev_rule.stats.total_hits.add(prev_hits)
+        if resolution_hits:
+            resolver.hits += resolution_hits
+
+        if overrides is not None:
+            self._route_overrides(
+                overrides,
+                request,
+                config,
+                limits,
+                is_unlimited,
+                keys,
+                categories,
+                banks,
+                ps_bank,
+                hits_addend,
+                hits_clamped,
+                now,
+            )
+
+        statuses: List[Optional[DescriptorStatus]] = [None] * n
+        items: List[tuple] = []  # (bank, engine, WorkItem)
+        for bank_idx in range(n_lanes):
+            rows, enc, tparts = banks[bank_idx]
+            if rows:
+                items.append(
+                    (
+                        bank_idx,
+                        self.lanes[bank_idx],
+                        self._make_packed_item(
+                            rows, keys, limits, hits_addend, now, statuses,
+                            enc, tparts,
+                        ),
+                    )
+                )
+        if ps_bank is not None and ps_bank[0]:
+            rows, enc, tparts = ps_bank
+            items.append(
+                (
+                    n_lanes,
+                    self.per_second_engine,
+                    self._make_packed_item(
+                        rows, keys, limits, hits_addend, now, statuses,
+                        enc, tparts,
+                    ),
+                )
+            )
+        return items, statuses, categories, keys, limits, is_unlimited, hits_addend, now
+
+    def _route_overrides(
+        self,
+        overrides: List[int],
+        request: RateLimitRequest,
+        config,
+        limits,
+        is_unlimited,
+        keys,
+        categories,
+        banks,
+        ps_bank,
+        hits_addend: int,
+        hits_clamped: int,
+        now: int,
+    ) -> None:
+        """Uncached leg for request-supplied override descriptors: the
+        legacy get_limit + key-generator pipeline, routed into the same
+        per-bank accumulators as the fast path (same stem hash, so an
+        override and its configured twin share a lane)."""
+        n_lanes = len(self.lanes)
+        local_cache = self.local_cache
+        scratch = np.empty(1, dtype=LANE_DTYPE)
+        expiry_by_unit: dict = {}
+        for i in overrides:
+            desc = request.descriptors[i]
+            rule = config.get_limit(request.domain, desc)
+            if rule is not None and rule.unlimited:
+                is_unlimited[i] = True
+                continue
+            limits[i] = rule
+            key = self.key_generator.generate(request.domain, desc, rule, now)
+            keys[i] = key
+            if key.key == "":
+                continue
+            rule.stats.total_hits.add(hits_addend)
+            if local_cache is not None and local_cache.contains(key.key):
+                categories[i] = _CAT_SKIP if rule.shadow_mode else _CAT_LOCAL
+                continue
+            categories[i] = _CAT_ENGINE
+            b = key.key.encode("utf-8")
+            if ps_bank is not None and key.per_second:
+                bank = ps_bank
+            elif n_lanes == 1:
+                bank = banks[0]
+            else:
+                stem = b[: key.stem_blen] if key.stem_blen else b
+                bank = banks[crc32(stem) % n_lanes]
+            unit = rule.limit.unit
+            e = expiry_by_unit.get(unit)
+            if e is None:
+                e = expiry_by_unit[unit] = window_start(
+                    now, unit
+                ) + unit_to_divider(unit)
+            scratch[0] = (
+                e,
+                hits_clamped,
+                rule.limit.requests_per_unit,
+                len(b),
+                1 if rule.shadow_mode else 0,
+            )
+            bank[0].append(i)
+            bank[1].append(b)
+            bank[2].append(scratch.tobytes())
+
+    def do_limit(
+        self,
+        request: RateLimitRequest,
+        limits: Sequence[Optional[RateLimitRule]],
+    ) -> List[DescriptorStatus]:
+        items, statuses, categories, keys, hits_addend, now = self._prepare(
+            request, limits
+        )
+        return self._execute(
+            limits, items, statuses, categories, hits_addend, now,
+            len(request.descriptors),
+        )
+
+    def do_limit_resolved(self, request: RateLimitRequest, config):
+        """The descriptor-resolution fast path: the service hands the
+        whole request + its config snapshot here; rule lookup rides the
+        resolution cache and the response legs come back together.
+
+        Returns (statuses, limits, is_unlimited) — the same values the
+        service's legacy _construct_limits_to_check + do_limit pair
+        produces, decision-identical."""
+        (
+            items,
+            statuses,
+            categories,
+            keys,
+            limits,
+            is_unlimited,
+            hits_addend,
+            now,
+        ) = self._prepare_resolved(request, config)
+        statuses = self._execute(
+            limits, items, statuses, categories, hits_addend, now,
+            len(request.descriptors),
+        )
+        return statuses, limits, is_unlimited
+
+    def _execute(
+        self,
+        limits,
+        prep_items,
+        statuses,
+        categories,
+        hits_addend: int,
+        now: int,
+        n: int,
+    ) -> List[DescriptorStatus]:
+        """The device half: submit every bank's WorkItem, wait, then
+        fill the non-engine categories."""
+        n_lanes = len(self.lanes)
+        # When this request's trace is recording, stamp each item's
+        # dispatcher passage (submit here; launch/complete on the
+        # dispatcher threads via the WorkItem trace seam) and convert
+        # the stamps to spans after wait() — see _record_item_spans.
+        span = TRACER.current()
+        items: List[tuple] = []  # (engine, WorkItem)
+        for bank, engine, item in prep_items:
             if span is not None:
                 item.trace = {
                     "bank": "per_second" if bank == n_lanes else f"lane{bank}",
@@ -252,6 +565,16 @@ class TpuRateLimitCache:
                 from ..service import CacheError
 
                 raise CacheError(f"counter engine failure: {e}") from e
+        # All waits succeeded: the completers' set() calls happened-
+        # before here and nothing touches these events again, so they
+        # are safe to clear and recycle (see _event_pool).  Failed or
+        # timed-out items above leave the loop by raising and keep
+        # their events out of the pool.
+        pool = self._event_pool
+        if len(pool) < 1024:
+            for _, item in items:
+                item.event.clear()
+                pool.append(item.event)
         if span is not None:
             self._record_item_spans(span, items)
 
@@ -337,7 +660,26 @@ class TpuRateLimitCache:
     def register_stats(self, store, scope: str = "ratelimit.tpu") -> None:
         """Live gauges for each bank (slot-table occupancy/evictions,
         dispatcher queue depth) — the analog of the reference's redis
-        pool gauges (driver_impl.go:17-29)."""
+        pool gauges (driver_impl.go:17-29) — plus the resolution/stem
+        cache counters, so a key-cardinality blowup (clears climbing,
+        hit rate collapsing) is visible on /metrics instead of silent."""
+        kg = self.key_generator
+        store.counter_fn(scope + ".stem_cache_clears", lambda: kg.clears)
+        store.gauge_fn(scope + ".stem_cache.entries", lambda: len(kg))
+        res = self.resolver
+        if res is not None:
+            store.counter_fn(
+                scope + ".resolution_cache.hits", lambda: res.hits
+            )
+            store.counter_fn(
+                scope + ".resolution_cache.misses", lambda: res.misses
+            )
+            store.counter_fn(
+                scope + ".resolution_cache.clears", lambda: res.clears
+            )
+            store.gauge_fn(
+                scope + ".resolution_cache.entries", lambda: len(res)
+            )
         for idx, engine in enumerate(self.engines()):
             base = f"{scope}.bank{idx}"
             # Cached snapshots updated by the table-owning thread —
@@ -461,22 +803,15 @@ class TpuRateLimitCache:
         the RPC thread: the dispatcher's serial collector then only
         concatenates packs (dispatcher.submit_items), so per-lane
         Python cost parallelizes across RPC handler threads instead of
-        bottlenecking the device queue."""
+        bottlenecking the device queue.  (The resolution fast path
+        skips this entirely — _make_packed_item joins pre-serialized
+        template records instead.)"""
         n_rows = len(rows)
-        jitters = None
-        if self.expiration_jitter_max_seconds > 0:
-            # Spread slot reclamation like the reference spreads Redis
-            # TTLs (fixed_cache_impl.go:71-74); one lock acquisition
-            # per request, not per lane.
-            with self._jitter_lock:
-                jitters = [
-                    self.jitter_rand.randrange(self.expiration_jitter_max_seconds)
-                    for _ in rows
-                ]
+        jitters = self._draw_jitters(rows)
         enc: List[bytes] = []
-        meta = np.empty(n_rows, dtype=LANE_DTYPE)
         hits_clamped = min(hits_addend, 0xFFFFFFFF)
         expiry_by_unit: dict = {}
+        meta = np.empty(n_rows, dtype=LANE_DTYPE)
         for j, i in enumerate(rows):
             rule = limits[i]
             unit = rule.limit.unit
@@ -485,8 +820,6 @@ class TpuRateLimitCache:
                 e = expiry_by_unit[unit] = window_start(
                     now, unit
                 ) + unit_to_divider(unit)
-            if jitters is not None:
-                e += jitters[j]
             # Multi-lane routing already encoded the key; reuse it.
             b = (
                 enc_keys[i]
@@ -496,23 +829,84 @@ class TpuRateLimitCache:
             enc.append(b)
             meta[j] = (
                 e,
-                hits_clamped,
+                0,  # hits stamped for all rows below
                 rule.limit.requests_per_unit,
                 len(b),
                 1 if rule.shadow_mode else 0,
             )
+        meta["hits"] = hits_clamped
+        if jitters is not None:
+            meta["expiry"] += np.asarray(jitters, dtype=np.int64)
         pack = LanePack(key_blob=b"".join(enc), meta=meta)
+        return self._finish_item(
+            rows, keys, limits, hits_addend, now, statuses, pack
+        )
 
+    def _make_packed_item(
+        self,
+        rows: List[int],
+        keys,
+        limits,
+        hits_addend: int,
+        now: int,
+        statuses: List[Optional[DescriptorStatus]],
+        enc: List[bytes],
+        tparts: List[bytes],
+    ) -> WorkItem:
+        """Resolution-fast-path packer: the per-bank accumulators
+        already hold the memoized key bytes and 24-byte template
+        records, so the pack is two joins and two zero-copy views.
+        Templates pre-stamp hits=1 (the common addend; override rows
+        wrote the real value), so the field write is only paid when a
+        request carries a different addend."""
+        buf = bytearray(b"".join(tparts))
+        meta = np.frombuffer(buf, dtype=LANE_DTYPE)
+        # Both views share `buf`; handing meta_u8 to LanePack skips
+        # its view()+safety-check construction cost.
+        meta_u8 = np.frombuffer(buf, dtype=np.uint8)
+        hits_clamped = min(hits_addend, 0xFFFFFFFF)
+        if hits_clamped != 1:
+            meta["hits"] = hits_clamped
+        jitters = self._draw_jitters(rows)
+        if jitters is not None:
+            meta["expiry"] += np.asarray(jitters, dtype=np.int64)
+        pack = LanePack(key_blob=b"".join(enc), meta=meta, meta_u8=meta_u8)
+        return self._finish_item(
+            rows, keys, limits, hits_addend, now, statuses, pack
+        )
+
+    def _draw_jitters(self, rows) -> Optional[List[int]]:
+        if self.expiration_jitter_max_seconds <= 0:
+            return None
+        # Spread slot reclamation like the reference spreads Redis
+        # TTLs (fixed_cache_impl.go:71-74); one lock acquisition
+        # per request, not per lane.
+        with self._jitter_lock:
+            return [
+                self.jitter_rand.randrange(self.expiration_jitter_max_seconds)
+                for _ in rows
+            ]
+
+    def _finish_item(
+        self, rows, keys, limits, hits_addend, now, statuses, pack
+    ) -> WorkItem:
         def apply(decisions: HostDecisions) -> None:
             self._apply_decisions(
                 rows, keys, limits, hits_addend, now, decisions, statuses
             )
 
+        pool = self._event_pool
+        event = pool.pop() if pool else threading.Event()
         # defer_apply: status assembly runs on THIS RPC thread inside
         # item.wait(), not on the dispatcher's completer — it was the
         # completer's largest serial leg (host_path.json).
         return WorkItem(
-            now=now, lanes=(), pack=pack, apply=apply, defer_apply=True
+            now=now,
+            lanes=(),
+            pack=pack,
+            apply=apply,
+            defer_apply=True,
+            event=event,
         )
 
     def _apply_decisions(

@@ -23,6 +23,7 @@ operators migrating from the reference see familiar diagnostics.
 
 from __future__ import annotations
 
+import itertools
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
@@ -143,12 +144,23 @@ def _as_uint32(file: ConfigFile, value, what: str) -> int:
     return value
 
 
+# Monotonically increasing config generation (process-wide).  Every
+# RateLimitConfig instance gets a unique generation at construction, so
+# load_config stamps each successfully loaded config with a fresh one.
+# The descriptor-resolution cache (limiter/resolution.py) keys its
+# validity on this: entries resolved under an older generation miss and
+# re-resolve.  A FAILED reload never replaces the service's config
+# object, so the old generation — and the warm cache — survive it.
+_GENERATION = itertools.count(1)
+
+
 class RateLimitConfig:
     """A loaded, immutable limit configuration (reference RateLimitConfig)."""
 
     def __init__(self, stats_manager: Manager):
         self._domains: Dict[str, _Node] = {}
         self._stats_manager = stats_manager
+        self.generation = next(_GENERATION)
 
     # -- loading ---------------------------------------------------------
 

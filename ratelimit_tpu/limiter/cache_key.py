@@ -14,7 +14,7 @@ brand-new key, which is how fixed windows "expire" without TTLs.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
+from typing import Optional, Sequence
 
 from ..api import Descriptor, Unit
 from ..config import RateLimitRule
@@ -27,9 +27,29 @@ class CacheKey:
     # True when the limit's unit is SECOND; routes to the dedicated
     # per-second counter bank (dual-Redis analog, cache_key.go:34-40).
     per_second: bool
+    # utf-8 byte length of the window-independent stem prefix of
+    # ``key``.  Lane routing hashes the stem (not the full key) so a
+    # key keeps its lane across window rollovers and so the cached
+    # (limiter/resolution.py) and uncached paths route identically; 0
+    # means unknown (hand-built keys) and falls back to the full key.
+    stem_blen: int = 0
 
 
 EMPTY_KEY = CacheKey("", False)
+
+
+def build_stem(prefix: str, domain: str, entries: Sequence) -> str:
+    """The window-independent key prefix
+    (``<prefix><domain>_<k>_<v>_..._``) — the single construction site
+    shared by CacheKeyGenerator and the descriptor-resolution cache so
+    the two paths can never drift byte-wise."""
+    parts = [prefix, domain, "_"]
+    for entry in entries:
+        parts.append(entry.key)
+        parts.append("_")
+        parts.append(entry.value)
+        parts.append("_")
+    return "".join(parts)
 
 
 class CacheKeyGenerator:
@@ -45,6 +65,13 @@ class CacheKeyGenerator:
         self.prefix = prefix
         self._stems: dict = {}
         self._stem_cap = int(stem_cache_entries)
+        # Full-clear tally (clear-on-full capacity policy); exported
+        # as `...stem_cache_clears` so a key-cardinality blowup is
+        # visible on /metrics instead of silent.
+        self.clears = 0
+
+    def __len__(self) -> int:
+        return len(self._stems)
 
     def generate(
         self, domain: str, descriptor: Descriptor, rule: Optional[RateLimitRule], now: int
@@ -71,16 +98,13 @@ class CacheKeyGenerator:
                 # Rare full reset beats per-entry LRU bookkeeping on
                 # the hot path; regeneration is just the uncached cost.
                 self._stems.clear()
-            parts = [self.prefix, domain, "_"]
-            for entry in descriptor.entries:
-                parts.append(entry.key)
-                parts.append("_")
-                parts.append(entry.value)
-                parts.append("_")
-            # [stem, (last_window, last_CacheKey)] — the finished
-            # CacheKey is cached per window, so a hot descriptor costs
-            # one dict hit + one comparison until its window rolls.
-            ce = self._stems[ck] = ["".join(parts), None]
+                self.clears += 1
+            stem = build_stem(self.prefix, domain, descriptor.entries)
+            # [stem, (last_window, last_CacheKey), stem_byte_len] —
+            # the finished CacheKey is cached per window, so a hot
+            # descriptor costs one dict hit + one comparison until its
+            # window rolls.
+            ce = self._stems[ck] = [stem, None, len(stem.encode("utf-8"))]
         pair = ce[1]  # ONE atomic read: window and key travel together
         if (
             pair is not None
@@ -88,7 +112,7 @@ class CacheKeyGenerator:
             and pair[1].per_second == per_second
         ):
             return pair[1]
-        out = CacheKey(ce[0] + str(window), per_second)
+        out = CacheKey(ce[0] + str(window), per_second, ce[2])
         # Single-slot tuple swap: a concurrent reader sees either the
         # old (window, key) pair or the new one, never a mix — two
         # threads straddling a window rollover each get the key for

@@ -1,0 +1,241 @@
+"""Descriptor-resolution cache: one dict hit from proto entries to
+packed lanes.
+
+The per-request Python pipeline — ``get_limit`` trie walk, key-stem
+assembly, utf-8 encode, crc32 lane routing, per-lane ``LANE_DTYPE``
+record construction — is window-independent for everything except the
+window suffix and the hits addend.  A ``ResolutionCache`` memoizes all
+of it per interned ``(domain, descriptor.entries)``: the matched
+:class:`RateLimitRule` (or None / unlimited), its stats handles (which
+the stats Manager already interns per key, so they survive reloads),
+the encoded utf-8 key stem, the lane index (``crc32(stem) % n_lanes``),
+the per-second-bank flag, and a pre-filled ``LANE_DTYPE`` template
+record where only ``expiry`` and ``hits`` are stamped per request.
+
+The reference memoizes only the cheap half of this (pooled
+``bytes.Buffer`` key building, cache_key.go:17-29) and gets the rest
+free from Go; here the full resolution is the measured host-path tax
+(benchmarks/results/host_path.json) so the whole pipeline collapses
+onto one dict hit.
+
+Invalidation is a config **generation counter**: every
+:class:`RateLimitConfig` carries a monotonically increasing
+``generation`` (config/loader.py); entries record the generation they
+were resolved under and miss when it moves.  A FAILED reload keeps the
+old config object AND its old generation (service/ratelimit.py keeps
+the previous config on ConfigError), so the warm cache survives bad
+pushes.  Request-supplied overrides (``descriptor.limit is not None``)
+bypass the cache entirely, and the entry map is capacity-bounded with
+the same clear-on-full policy as the key-stem cache (rare full reset
+beats per-entry LRU bookkeeping on the hot path).
+
+Thread model: resolve() runs concurrently on RPC handler threads with
+no lock — dict get/set are single atomic ops under the GIL, a racing
+double-resolve builds equivalent entries (last write wins), and the
+hit/miss tallies are plain ints whose rare lost increments are an
+accepted stats-only race (the same trade the stem cache makes).
+
+This module is dependency-light on purpose: the lane record dtype is
+injected by the backend (``lane_dtype=LANE_DTYPE``) so the limiter
+layer never imports the device stack.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+from zlib import crc32
+
+import numpy as np
+
+from ..api import Descriptor, Unit
+from ..utils.time import unit_to_divider
+from .cache_key import CacheKey, build_stem
+
+
+class WindowState:
+    """Everything about one (resolved descriptor, window) pair: the
+    finished :class:`CacheKey`, its utf-8 encoding (the pack blob
+    piece), and the template lane record with ``expiry`` pre-stamped
+    to ``window_start + divider`` — per request only ``hits`` remains.
+    ``template_bytes`` is the record's raw encoding: the packer joins
+    these (bytes.join is ~an order cheaper than per-row structured-
+    array assignment) and reinterprets the blob as one LANE_DTYPE
+    array.
+
+    Immutable after construction; the owning entry swaps the whole
+    object on window rollover so concurrent readers see either the old
+    window's state or the new one, never a mix."""
+
+    __slots__ = (
+        "window",
+        "cache_key",
+        "key_bytes",
+        "template",
+        "template_bytes",
+        "_arr",
+    )
+
+    def __init__(
+        self,
+        window: int,
+        cache_key: CacheKey,
+        key_bytes: bytes,
+        template: Optional[np.void],
+        arr: Optional[np.ndarray],
+    ):
+        self.window = window
+        self.cache_key = cache_key
+        self.key_bytes = key_bytes
+        self.template = template
+        self.template_bytes = arr.tobytes() if arr is not None else b""
+        # The 1-element array backing `template` (np.void records are
+        # views; keep the base alive explicitly).
+        self._arr = arr
+
+
+class ResolvedDescriptor:
+    """One interned (domain, entries) resolution: rule + everything
+    window-independent, plus a single-slot per-window memo."""
+
+    __slots__ = (
+        "generation",
+        "rule",
+        "unlimited",
+        "per_second",
+        "stem",
+        "stem_bytes",
+        "n_lanes",
+        "lane",
+        "unit",
+        "divider",
+        "_lane_dtype",
+        "_win",
+    )
+
+    def __init__(self, generation: int, rule, stem: str, n_lanes: int, lane_dtype):
+        self.generation = generation
+        self.rule = rule
+        self.unlimited = rule is not None and rule.unlimited
+        self.stem = stem
+        self.stem_bytes = stem.encode("utf-8")
+        self.n_lanes = n_lanes
+        self.lane = crc32(self.stem_bytes) % n_lanes if n_lanes > 1 else 0
+        self._lane_dtype = lane_dtype
+        self._win: Optional[WindowState] = None
+        if rule is not None and not rule.unlimited:
+            self.unit = rule.limit.unit
+            self.divider = unit_to_divider(self.unit)
+            self.per_second = self.unit == Unit.SECOND
+        else:
+            self.unit = None
+            self.divider = 0
+            self.per_second = False
+
+    def rehash_lanes(self, n_lanes: int) -> None:
+        """Lane-count change (new cache topology): recompute the route
+        for the new modulus.  The amnesia envelope is the same as a
+        restart with a changed TPU_NUM_LANES — old windows' counters
+        age out in the old lane while the key counts afresh."""
+        self.lane = crc32(self.stem_bytes) % n_lanes if n_lanes > 1 else 0
+        self.n_lanes = n_lanes
+
+    def window_state(self, now: int) -> WindowState:
+        """The memoized per-window state, rebuilt once per rollover.
+        Byte-identical to CacheKeyGenerator output: key string is
+        ``stem + str(window_start)``."""
+        # Inline window_start(now, unit): the divider is resolved once
+        # at entry construction, so the hot path skips the per-call
+        # Unit coercion + divider lookup (measured ~1.5us/descriptor).
+        w = now - now % self.divider
+        ws = self._win
+        if ws is not None and ws.window == w:
+            return ws
+        suffix = str(w)
+        key_str = self.stem + suffix
+        key_bytes = self.stem_bytes + suffix.encode("ascii")
+        template = arr = None
+        if self._lane_dtype is not None:
+            rule = self.rule
+            arr = np.empty(1, dtype=self._lane_dtype)
+            arr[0] = (
+                w + self.divider,  # expiry base (jitter stamped later)
+                1,  # hits pre-stamped to the common addend; the packer
+                #    only overwrites when the request carries hits != 1
+                rule.limit.requests_per_unit,
+                len(key_bytes),
+                1 if rule.shadow_mode else 0,
+            )
+            template = arr[0]
+        ws = WindowState(
+            w,
+            CacheKey(key_str, self.per_second, len(self.stem_bytes)),
+            key_bytes,
+            template,
+            arr,
+        )
+        self._win = ws  # single-slot swap: readers see old or new
+        return ws
+
+
+class ResolutionCache:
+    """Per-service map from interned ``(domain, entries)`` to a
+    :class:`ResolvedDescriptor`.  See module docstring for the
+    invalidation and threading contract."""
+
+    def __init__(
+        self,
+        prefix: str = "",
+        n_lanes: int = 1,
+        lane_dtype=None,
+        capacity: int = 1 << 16,
+    ):
+        self.prefix = prefix
+        self.n_lanes = max(1, int(n_lanes))
+        self.lane_dtype = lane_dtype
+        self.capacity = int(capacity)
+        self._entries: dict = {}
+        # Stats-only tallies; benign GIL races accepted (see module
+        # docstring).  Exported as counters via register_stats on the
+        # owning backend.
+        self.hits = 0
+        self.misses = 0
+        self.clears = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def resolve(self, config, domain: str, descriptor: Descriptor):
+        """One dict hit on the hot path.  Returns None for
+        request-supplied overrides (the caller falls back to the
+        uncached ``get_limit`` + key-generator path); otherwise a
+        :class:`ResolvedDescriptor` valid for ``config.generation``."""
+        if descriptor.limit is not None:
+            return None
+        ck: Tuple[str, tuple] = (domain, descriptor.entries)
+        e = self._entries.get(ck)
+        if e is not None and e.generation == config.generation:
+            if e.n_lanes != self.n_lanes:
+                e.rehash_lanes(self.n_lanes)
+            self.hits += 1
+            return e
+        self.misses += 1
+        rule = config.get_limit(domain, descriptor)
+        e = ResolvedDescriptor(
+            config.generation,
+            rule,
+            build_stem(self.prefix, domain, descriptor.entries),
+            self.n_lanes,
+            self.lane_dtype if rule is not None and not rule.unlimited else None,
+        )
+        if len(self._entries) >= self.capacity:
+            # Same clear-on-full policy as the stem cache: a key-
+            # cardinality blowup resets the map (and is counted, so
+            # it is visible on /metrics instead of silent).
+            self._entries.clear()
+            self.clears += 1
+        self._entries[ck] = e
+        return e
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self.clears += 1

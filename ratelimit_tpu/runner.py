@@ -146,6 +146,7 @@ def create_limiter(s: Settings, stats_manager: Manager, local_cache, time_source
             dispatch_timeout_s=s.tpu_dispatch_timeout_s,
             pipeline_depth=s.tpu_pipeline_depth,
             unhealthy_after=s.tpu_unhealthy_after,
+            resolution_cache_entries=s.resolution_cache_entries,
         )
     raise ValueError(f"Invalid setting for BackendType: {s.backend_type}")
 

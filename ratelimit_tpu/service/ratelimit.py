@@ -78,7 +78,15 @@ class RateLimitService:
         self._settings_reloader = settings_reloader
 
         self._config: Optional[RateLimitConfig] = None
+        # Writers only: the hot path reads `self._config` as a plain
+        # attribute load (atomic under CPython; the whole config is one
+        # immutable object swapped at reload), so no per-RPC lock tax.
         self._config_lock = threading.RLock()
+        # Descriptor-resolution fast path (limiter/resolution.py): the
+        # backend owns the cache when it supports it (tpu_cache builds
+        # one from its lane/prefix topology); other backends fall back
+        # to the uncached get_limit + key-generator path.
+        self._resolver = getattr(cache, "resolver", None)
 
         runtime.add_update_callback(self._on_runtime_update)
         self.reload_config()
@@ -123,8 +131,11 @@ class RateLimitService:
 
     def _construct_limits_to_check(self, request: RateLimitRequest):
         """Per-descriptor rule lookup + unlimited extraction
-        (ratelimit.go:104-146)."""
-        config = self.get_current_config()
+        (ratelimit.go:104-146).  The legacy path; with a resolution
+        cache attached the whole leg fuses into the backend's
+        do_limit_resolved instead (one dict hit per descriptor)."""
+        # Plain attribute read — no lock (see __init__).
+        config = self._config
         if config is None:
             raise ServiceError("no rate limit configuration loaded")
 
@@ -148,14 +159,30 @@ class RateLimitService:
         if len(request.descriptors) == 0:
             raise ServiceError("rate limit descriptor list must not be empty")
 
-        limits, is_unlimited = self._construct_limits_to_check(request)
-        # The backend leg as its own span: whatever cache is plugged in
-        # (tpu dispatcher, write-behind, memory) its full do_limit cost
-        # separates from rule lookup + response assembly; the tpu cache
-        # nests dispatch/kernel spans inside (backends/tpu_cache.py).
-        with TRACER.span("backend.do_limit") as span:
-            span.set_attr("backend", type(self.cache).__name__)
-            statuses = self.cache.do_limit(request, limits)
+        if self._resolver is not None:
+            # Descriptor-resolution fast path: rule lookup, key
+            # generation and lane packing fuse into ONE pass inside
+            # the backend (tpu_cache.do_limit_resolved), one dict hit
+            # per descriptor.  The do_limit span therefore contains
+            # rule lookup here (it is part of the fused leg).
+            config = self._config  # plain attribute read — no lock
+            if config is None:
+                raise ServiceError("no rate limit configuration loaded")
+            with TRACER.span("backend.do_limit") as span:
+                span.set_attr("backend", type(self.cache).__name__)
+                statuses, limits, is_unlimited = (
+                    self.cache.do_limit_resolved(request, config)
+                )
+        else:
+            limits, is_unlimited = self._construct_limits_to_check(request)
+            # The backend leg as its own span: whatever cache is
+            # plugged in (tpu dispatcher, write-behind, memory) its
+            # full do_limit cost separates from rule lookup + response
+            # assembly; the tpu cache nests dispatch/kernel spans
+            # inside (backends/tpu_cache.py).
+            with TRACER.span("backend.do_limit") as span:
+                span.set_attr("backend", type(self.cache).__name__)
+                statuses = self.cache.do_limit(request, limits)
         assert len(limits) == len(statuses)
 
         response = RateLimitResponse()

@@ -179,6 +179,11 @@ class Settings:
     tpu_batch_buckets: List[int] = field(
         default_factory=lambda: [8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096]
     )
+    # Descriptor-resolution cache capacity (limiter/resolution.py):
+    # interned (domain, entries) -> rule + key stem + lane route +
+    # packed-lane template, invalidated by config generation.  Clear-
+    # on-full past this bound; 0 disables the fast path entirely.
+    resolution_cache_entries: int = 1 << 16
     # Micro-batch dispatcher (the implicit-pipelining analog,
     # settings.go:71-77; radix defaults to a 150us window).
     tpu_batch_window_us: int = 200
@@ -279,6 +284,7 @@ def new_settings() -> Settings:
         tpu_batch_buckets=_env_int_list(
             "TPU_BATCH_BUCKETS", [8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096]
         ),
+        resolution_cache_entries=_env_int("RESOLUTION_CACHE_ENTRIES", 1 << 16),
         tpu_batch_window_us=_env_int("TPU_BATCH_WINDOW_US", 200),
         tpu_batch_limit=_env_int("TPU_BATCH_LIMIT", 4096),
         tpu_dispatch_timeout_s=_env_float("TPU_DISPATCH_TIMEOUT_S", 120.0),

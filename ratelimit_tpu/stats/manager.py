@@ -234,6 +234,7 @@ class StatsStore:
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._gauge_fns: Dict[str, "callable"] = {}
+        self._counter_fns: Dict[str, "callable"] = {}
         self._timers: Dict[str, Timer] = {}
         self._histograms: Dict[str, Histogram] = {}
         self._lock = threading.Lock()
@@ -290,9 +291,23 @@ class StatsStore:
                 g = self._gauges[name] = Gauge(name)
             return g
 
+    def counter_fn(self, name: str, fn) -> None:
+        """Register a live COUNTER evaluated at snapshot time (the
+        gauge_fn pattern for monotonically increasing tallies kept as
+        plain ints by their owner — e.g. the resolution/stem cache
+        hit counts, which deliberately avoid a per-request Lock).
+        Rendered with counter type on /metrics; not drained to statsd
+        (the statsd sink only flushes delta-tracking Counter objects)."""
+        with self._lock:
+            self._counter_fns[name] = fn
+
     def counters(self) -> Dict[str, int]:
         with self._lock:
-            return {name: c.value() for name, c in self._counters.items()}
+            out = {name: c.value() for name, c in self._counters.items()}
+            fns = list(self._counter_fns.items())
+        for name, fn in fns:
+            out[name] = int(fn())
+        return out
 
     def gauge_fn(self, name: str, fn) -> None:
         """Register a live gauge evaluated at snapshot time (reference

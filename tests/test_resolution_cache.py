@@ -1,0 +1,405 @@
+"""Descriptor-resolution cache tests (limiter/resolution.py).
+
+Covers the invalidation contract (config generation flip, FAILED
+reload keeping the warm cache, lane-count re-route), the bypasses
+(request-supplied overrides), stats identity across reloads, byte-
+identical keys vs CacheKeyGenerator, the clear-on-full capacity
+policy, /metrics exposure, and decision parity between the resolved
+fast path and the uncached path (shadow, unlimited, override, and
+window-rollover cases).
+"""
+
+from zlib import crc32
+
+import pytest
+
+from ratelimit_tpu.api import (
+    Code,
+    Descriptor,
+    LimitOverride,
+    RateLimitRequest,
+    Unit,
+)
+from ratelimit_tpu.backends import CounterEngine, TpuRateLimitCache
+from ratelimit_tpu.backends.dispatcher import LANE_DTYPE
+from ratelimit_tpu.config import ConfigFile, load_config
+from ratelimit_tpu.limiter.cache_key import CacheKeyGenerator
+from ratelimit_tpu.limiter.resolution import ResolutionCache
+from ratelimit_tpu.service import RateLimitService
+from ratelimit_tpu.stats.manager import Manager
+from ratelimit_tpu.utils.time import PinnedTimeSource
+
+BASIC_YAML = """
+domain: test-domain
+descriptors:
+  - key: key1
+    value: value1
+    rate_limit:
+      unit: minute
+      requests_per_unit: 10
+  - key: wild
+    rate_limit:
+      unit: hour
+      requests_per_unit: 5
+  - key: unlim
+    rate_limit:
+      unlimited: true
+  - key: shady
+    shadow_mode: true
+    rate_limit:
+      unit: second
+      requests_per_unit: 2
+"""
+
+
+def make_config(mgr, yaml=BASIC_YAML, name="config.basic"):
+    return load_config([ConfigFile(name, yaml)], mgr)
+
+
+@pytest.fixture(scope="module")
+def shared_engine():
+    return CounterEngine(num_slots=1 << 10, buckets=(8, 32))
+
+
+@pytest.fixture
+def engine(shared_engine):
+    shared_engine.reset()
+    return shared_engine
+
+
+# -- ResolutionCache unit behavior ------------------------------------
+
+
+def test_hit_returns_same_entry_and_counts():
+    mgr = Manager()
+    cfg = make_config(mgr)
+    res = ResolutionCache(lane_dtype=LANE_DTYPE)
+    d = Descriptor.of(("key1", "value1"))
+    e1 = res.resolve(cfg, "test-domain", d)
+    e2 = res.resolve(cfg, "test-domain", d)
+    assert e1 is e2
+    assert (res.hits, res.misses) == (1, 1)
+    assert e1.rule.limit.requests_per_unit == 10
+    assert not e1.per_second and e1.unit == Unit.MINUTE
+
+
+def test_no_rule_and_unlimited_are_cached_negative_entries():
+    mgr = Manager()
+    cfg = make_config(mgr)
+    res = ResolutionCache(lane_dtype=LANE_DTYPE)
+    none = res.resolve(cfg, "test-domain", Descriptor.of(("nope", "x")))
+    assert none.rule is None and not none.unlimited
+    unlim = res.resolve(cfg, "test-domain", Descriptor.of(("unlim", "y")))
+    assert unlim.rule is not None and unlim.unlimited
+    # Both hit on re-resolve (no trie walk).
+    res.resolve(cfg, "test-domain", Descriptor.of(("nope", "x")))
+    res.resolve(cfg, "test-domain", Descriptor.of(("unlim", "y")))
+    assert res.hits == 2
+
+
+def test_generation_flip_invalidates_stale_rule():
+    mgr = Manager()
+    cfg1 = make_config(mgr)
+    res = ResolutionCache(lane_dtype=LANE_DTYPE)
+    d = Descriptor.of(("key1", "value1"))
+    e1 = res.resolve(cfg1, "test-domain", d)
+    assert e1.rule.limit.requests_per_unit == 10
+    cfg2 = make_config(mgr, BASIC_YAML.replace("requests_per_unit: 10",
+                                               "requests_per_unit: 99"))
+    assert cfg2.generation > cfg1.generation
+    e2 = res.resolve(cfg2, "test-domain", d)
+    # Stale rule never served: the new generation re-resolves.
+    assert e2 is not e1
+    assert e2.rule.limit.requests_per_unit == 99
+    assert res.misses == 2
+
+
+def test_override_descriptor_bypasses():
+    mgr = Manager()
+    cfg = make_config(mgr)
+    res = ResolutionCache(lane_dtype=LANE_DTYPE)
+    d = Descriptor.of(
+        ("key1", "value1"), limit=LimitOverride(3, Unit.MINUTE)
+    )
+    assert res.resolve(cfg, "test-domain", d) is None
+    assert (res.hits, res.misses) == (0, 0)
+    assert len(res) == 0
+
+
+def test_lane_count_change_reroutes():
+    mgr = Manager()
+    cfg = make_config(mgr)
+    res = ResolutionCache(n_lanes=2, lane_dtype=LANE_DTYPE)
+    d = Descriptor.of(("key1", "value1"))
+    e = res.resolve(cfg, "test-domain", d)
+    assert e.lane == crc32(e.stem_bytes) % 2
+    res.n_lanes = 3
+    e2 = res.resolve(cfg, "test-domain", d)
+    assert e2 is e  # same entry, re-routed in place
+    assert e.n_lanes == 3
+    assert e.lane == crc32(e.stem_bytes) % 3
+
+
+def test_capacity_clear_on_full_is_counted():
+    mgr = Manager()
+    cfg = make_config(mgr)
+    res = ResolutionCache(lane_dtype=LANE_DTYPE, capacity=2)
+    for v in ("a", "b", "c"):
+        res.resolve(cfg, "test-domain", Descriptor.of(("key1", v)))
+    assert res.clears == 1
+    assert len(res) == 1  # cleared before inserting the third
+
+
+def test_keys_byte_identical_to_generator():
+    mgr = Manager()
+    yaml = """
+domain: d
+descriptors:
+  - key: sec
+    rate_limit: {unit: second, requests_per_unit: 4}
+  - key: minute
+    rate_limit: {unit: minute, requests_per_unit: 4}
+  - key: day
+    rate_limit: {unit: day, requests_per_unit: 4}
+  - key: multi
+    descriptors:
+      - key: sub
+        rate_limit: {unit: hour, requests_per_unit: 4}
+"""
+    cfg = make_config(mgr, yaml, name="config.keys")
+    gen = CacheKeyGenerator(prefix="pfx:")
+    res = ResolutionCache(prefix="pfx:", lane_dtype=LANE_DTYPE)
+    now = 1_700_000_123
+    descs = [
+        Descriptor.of(("sec", "v")),
+        Descriptor.of(("minute", "")),
+        Descriptor.of(("day", "x")),
+        Descriptor.of(("multi", ""), ("sub", "s")),
+    ]
+    for d in descs:
+        rule = cfg.get_limit("d", d)
+        ck = gen.generate("d", d, rule, now)
+        e = res.resolve(cfg, "d", d)
+        ws = e.window_state(now)
+        assert ws.cache_key.key == ck.key
+        assert ws.key_bytes == ck.key.encode("utf-8")
+        assert ws.cache_key.per_second == ck.per_second
+        assert ws.cache_key.stem_blen == ck.stem_blen
+        # Template record carries the window-independent lane fields.
+        assert int(ws.template["limits"]) == 4
+        assert int(ws.template["len"]) == len(ws.key_bytes)
+        assert int(ws.template["expiry"]) == ws.window + e.divider
+
+
+def test_window_state_rolls_over():
+    mgr = Manager()
+    cfg = make_config(mgr)
+    res = ResolutionCache(lane_dtype=LANE_DTYPE)
+    e = res.resolve(cfg, "test-domain", Descriptor.of(("shady", "s")))
+    ws1 = e.window_state(1000)
+    assert ws1 is e.window_state(1000)  # memoized within the window
+    ws2 = e.window_state(1001)  # SECOND unit: new window each second
+    assert ws2 is not ws1
+    assert ws2.cache_key.key.endswith("_1001")
+    assert int(ws2.template["expiry"]) == 1002
+
+
+# -- service-level invalidation ---------------------------------------
+
+
+class FakeRuntime:
+    def __init__(self, files):
+        self.files = dict(files)
+        self.callbacks = []
+
+    def snapshot(self):
+        data = dict(self.files)
+
+        class Snap:
+            def keys(self):
+                return sorted(data)
+
+            def get(self, key):
+                return data.get(key, "")
+
+        return Snap()
+
+    def add_update_callback(self, fn):
+        self.callbacks.append(fn)
+
+    def fire(self):
+        for fn in self.callbacks:
+            fn()
+
+
+def make_service(engine, clock, mgr, runtime_files=None, **cache_kwargs):
+    cache = TpuRateLimitCache(engine, clock, **cache_kwargs)
+    runtime = FakeRuntime(runtime_files or {"config.basic": BASIC_YAML})
+    svc = RateLimitService(runtime, cache, mgr, clock=clock)
+    return svc, cache, runtime
+
+
+def test_service_uses_resolver_and_counts_hits(engine):
+    clock = PinnedTimeSource(1234)
+    mgr = Manager()
+    svc, cache, _ = make_service(engine, clock, mgr)
+    req = RateLimitRequest("test-domain", [Descriptor.of(("key1", "value1"))], 0)
+    svc.should_rate_limit(req)
+    svc.should_rate_limit(req)
+    assert cache.resolver.misses == 1
+    assert cache.resolver.hits == 1
+
+
+def test_failed_reload_keeps_warm_cache(engine):
+    clock = PinnedTimeSource(1234)
+    mgr = Manager()
+    svc, cache, runtime = make_service(engine, clock, mgr)
+    d = Descriptor.of(("key1", "value1"))
+    req = RateLimitRequest("test-domain", [d], 0)
+    svc.should_rate_limit(req)
+    cfg_before = svc.get_current_config()
+    entry_before = cache.resolver.resolve(cfg_before, "test-domain", d)
+
+    runtime.files["config.basic"] = "domain: [broken"
+    runtime.fire()  # reload fails; old config AND generation survive
+    assert svc.stats.config_load_error.value() == 1
+    cfg_after = svc.get_current_config()
+    assert cfg_after is cfg_before
+
+    misses_before = cache.resolver.misses
+    svc.should_rate_limit(req)
+    assert cache.resolver.misses == misses_before  # still warm
+    assert (
+        cache.resolver.resolve(cfg_after, "test-domain", d) is entry_before
+    )
+
+
+def test_successful_reload_serves_new_rule_and_preserves_stats_identity(engine):
+    clock = PinnedTimeSource(1234)
+    mgr = Manager()
+    svc, cache, runtime = make_service(engine, clock, mgr)
+    d = Descriptor.of(("key1", "value1"))
+    req = RateLimitRequest("test-domain", [d], 0)
+    svc.should_rate_limit(req)
+    rule_before = svc.get_current_config().get_limit("test-domain", d)
+
+    # No-op reload: same YAML, new generation.
+    runtime.fire()
+    assert svc.stats.config_load_success.value() == 2
+    entry = cache.resolver.resolve(
+        svc.get_current_config(), "test-domain", d
+    )
+    # Stats identity: the Manager interns per-rule stats by key, so a
+    # reload hands the new rule the SAME counter objects.
+    assert entry.rule.stats is rule_before.stats
+
+    # Real change: stale limit never served after the generation flip.
+    runtime.files["config.basic"] = BASIC_YAML.replace(
+        "requests_per_unit: 10", "requests_per_unit: 3"
+    )
+    runtime.fire()
+    [st] = svc.should_rate_limit(req).statuses
+    assert st.current_limit.requests_per_unit == 3
+
+
+# -- decision parity: resolved fast path vs uncached path -------------
+
+
+def run_scenario(svc, clock):
+    """A scripted mixed workload exercising shadow, unlimited,
+    override, no-rule and window-rollover behavior; returns the
+    flattened (overall_code, per-descriptor code/remaining/duration)
+    transcript."""
+    out = []
+    descs = [
+        Descriptor.of(("key1", "value1")),
+        Descriptor.of(("wild", "anything")),
+        Descriptor.of(("unlim", "u")),
+        Descriptor.of(("shady", "s")),
+        Descriptor.of(("norule", "x")),
+        Descriptor.of(("key1", "value1"), limit=LimitOverride(2, Unit.MINUTE)),
+    ]
+    for step in range(8):
+        resp = svc.should_rate_limit(
+            RateLimitRequest("test-domain", descs, 0)
+        )
+        out.append(int(resp.overall_code))
+        for st in resp.statuses:
+            out.append(
+                (
+                    int(st.code),
+                    st.limit_remaining,
+                    st.duration_until_reset,
+                    None
+                    if st.current_limit is None
+                    else (
+                        st.current_limit.requests_per_unit,
+                        int(st.current_limit.unit),
+                    ),
+                )
+            )
+        if step == 3:
+            clock.advance(1)  # rolls the SECOND shadow window
+        if step == 5:
+            clock.advance(60)  # rolls the MINUTE windows
+    return out
+
+
+def test_resolved_path_decisions_identical_to_uncached():
+    clock_a = PinnedTimeSource(1_700_000_000)
+    clock_b = PinnedTimeSource(1_700_000_000)
+    eng_a = CounterEngine(num_slots=1 << 10, buckets=(8, 32))
+    eng_b = CounterEngine(num_slots=1 << 10, buckets=(8, 32))
+    mgr_a, mgr_b = Manager(), Manager()
+    svc_a, cache_a, _ = make_service(eng_a, clock_a, mgr_a)
+    svc_b, cache_b, _ = make_service(
+        eng_b, clock_b, mgr_b, resolution_cache_entries=0
+    )
+    assert cache_a.resolver is not None
+    assert cache_b.resolver is None
+    got = run_scenario(svc_a, clock_a)
+    want = run_scenario(svc_b, clock_b)
+    assert got == want
+    assert cache_a.resolver.hits > 0
+
+
+def test_resolved_path_multilane_parity():
+    clock_a = PinnedTimeSource(1_700_000_000)
+    clock_b = PinnedTimeSource(1_700_000_000)
+    lanes_a = [CounterEngine(num_slots=256, buckets=(8, 32)) for _ in range(2)]
+    lanes_b = [CounterEngine(num_slots=256, buckets=(8, 32)) for _ in range(2)]
+    mgr_a, mgr_b = Manager(), Manager()
+    svc_a, cache_a, _ = make_service(lanes_a, clock_a, mgr_a)
+    svc_b, cache_b, _ = make_service(
+        lanes_b, clock_b, mgr_b, resolution_cache_entries=0
+    )
+    got = run_scenario(svc_a, clock_a)
+    want = run_scenario(svc_b, clock_b)
+    assert got == want
+    # Same stem must land on the same lane in both modes (a split
+    # would double-count a key), so per-lane live-key counts match.
+    cache_a.flush(), cache_b.flush()
+    for la, lb in zip(cache_a.lanes, cache_b.lanes):
+        assert la.stat_live_keys == lb.stat_live_keys
+
+
+# -- /metrics exposure ------------------------------------------------
+
+
+def test_cache_counters_exposed_on_metrics(engine):
+    from ratelimit_tpu.observability import prometheus
+
+    clock = PinnedTimeSource(1234)
+    mgr = Manager()
+    svc, cache, _ = make_service(engine, clock, mgr)
+    cache.register_stats(mgr.store)
+    req = RateLimitRequest("test-domain", [Descriptor.of(("key1", "value1"))], 0)
+    svc.should_rate_limit(req)
+    svc.should_rate_limit(req)
+    text = prometheus.render(mgr.store)
+    assert "# TYPE ratelimit_tpu_resolution_cache_hits counter" in text
+    assert "ratelimit_tpu_resolution_cache_hits 1" in text
+    assert "ratelimit_tpu_resolution_cache_misses 1" in text
+    assert "ratelimit_tpu_resolution_cache_clears 0" in text
+    assert "ratelimit_tpu_stem_cache_clears 0" in text
+    assert "ratelimit_tpu_resolution_cache_entries 1" in text
