@@ -29,6 +29,15 @@ runs only the resolution section with few iterations, asserts the
 resolution cache reports a nonzero hit rate after warmup and that the
 fast path STAYS engaged (no misses during the measured phase), prints
 one JSON line, and exits non-zero on violation.  Writes no artifact.
+
+Hot-key sketch mode:
+      JAX_PLATFORMS=cpu python benchmarks/profile_host_path.py --hotkeys
+measures the per-request cost of the Space-Saving hot-key feed
+(observability/hotkeys.py) against the acceptance budget — <= ~2us/
+request with the sketch enabled, ~0 with HOTKEYS_TOP_K=0 — split into
+the front-half bump (steady state and eviction-churn worst case) and
+the post-decision outcome attribution.  Writes
+benchmarks/results/hotkeys_overhead.json (cited by PERF_NOTES.md).
 """
 
 from __future__ import annotations
@@ -219,7 +228,144 @@ def profile_resolution(results, quick: bool = False):
     return ok, info
 
 
+def profile_hotkeys():
+    """Per-request cost of the hot-key sketch feed, measured through
+    the real serving seams (same harness as profile_resolution).
+
+    Three configurations share one request set (n_reqs x 4
+    descriptors over DUP_KEYS distinct stems):
+
+    - ``disabled``:     HOTKEYS_TOP_K=0 (the ~0-cost baseline);
+    - ``steady``:       capacity >= keyspace — pure handle-bump path;
+    - ``churn``:        capacity << keyspace — every request's stems
+                        keep getting evicted, so the locked track()
+                        registration path runs constantly (worst
+                        case; production top-K traffic is steady).
+
+    The outcome-attribution leg (_note_hotkey_outcomes, which runs
+    after the device step) is timed separately on a completed
+    request's real statuses.
+    """
+    from ratelimit_tpu.api import Descriptor, RateLimitRequest  # noqa: E402
+    from ratelimit_tpu.backends.tpu_cache import TpuRateLimitCache  # noqa: E402
+    from ratelimit_tpu.service import RateLimitService  # noqa: E402
+    from ratelimit_tpu.stats.manager import Manager  # noqa: E402
+    from ratelimit_tpu.utils.time import PinnedTimeSource  # noqa: E402
+
+    n_reqs = 256
+    reps = 12
+    yaml = (
+        "domain: domain\n"
+        "descriptors:\n"
+        "  - key: key\n"
+        "    rate_limit:\n"
+        "      unit: hour\n"
+        "      requests_per_unit: 1000\n"
+    )
+
+    class _Runtime:
+        def __init__(self, files):
+            self._files = files
+
+        def snapshot(self):
+            files = self._files
+
+            class Snap:
+                def keys(self):
+                    return sorted(files)
+
+                def get(self, key):
+                    return files.get(key, "")
+
+            return Snap()
+
+        def add_update_callback(self, fn):
+            pass
+
+    def build(top_k):
+        clock = PinnedTimeSource(1_700_000_000)
+        engine = CounterEngine(num_slots=1 << 16)
+        cache = TpuRateLimitCache(engine, clock, hotkeys_top_k=top_k)
+        svc = RateLimitService(
+            _Runtime({"config.bench": yaml}), cache, Manager(), clock=clock
+        )
+        return svc, cache
+
+    rng = np.random.default_rng(7)
+    key_ids = rng.integers(0, DUP_KEYS, n_reqs * 4)
+    reqs = []
+    for r in range(n_reqs):
+        descs = [
+            Descriptor.of(("key", f"value{key_ids[r * 4 + j]}"))
+            for j in range(4)
+        ]
+        reqs.append(RateLimitRequest("domain", descs, 0))
+
+    def front(svc, cache):
+        pool = cache._event_pool
+        config = svc.get_current_config()
+        for req in reqs:
+            items, *_ = cache._prepare_resolved(req, config)
+            if len(pool) < 1024:
+                for _bank, _eng, item in items:
+                    pool.append(item.event)
+
+    import gc
+
+    gc.collect()
+    results = {"requests": n_reqs, "descriptors_per_request": 4}
+    times = {}
+    for name, top_k in (
+        ("disabled", 0),
+        ("steady", 2 * DUP_KEYS),
+        ("churn", 32),
+    ):
+        svc, cache = build(top_k)
+        front(svc, cache)  # warm caches (and the sketch handles)
+        t, _ = timed(front, svc, cache, reps=reps)
+        times[name] = t
+        results[f"front_{name}_us_per_req"] = t / n_reqs * 1e6
+
+    results["sketch_steady_overhead_us_per_req"] = (
+        (times["steady"] - times["disabled"]) / n_reqs * 1e6
+    )
+    results["sketch_churn_overhead_us_per_req"] = (
+        (times["churn"] - times["disabled"]) / n_reqs * 1e6
+    )
+
+    # Outcome attribution on real statuses (the post-decision leg).
+    svc, cache = build(2 * DUP_KEYS)
+    config = svc.get_current_config()
+    req = reqs[0]
+    (items, statuses, categories, _keys, limits, _unl, hits_addend, now, hot
+     ) = cache._prepare_resolved(req, config)
+    statuses = cache._execute(
+        limits, items, statuses, categories, hits_addend, now,
+        len(req.descriptors),
+    )
+    t_note, _ = timed(
+        lambda: cache._note_hotkey_outcomes(hot, statuses, limits, 1),
+        reps=200,
+    )
+    results["outcome_attribution_us_per_req"] = t_note * 1e6
+    results["total_steady_us_per_req"] = (
+        results["sketch_steady_overhead_us_per_req"] + t_note * 1e6
+    )
+
+    path = os.path.join(
+        os.path.dirname(__file__), "results", "hotkeys_overhead.json"
+    )
+    with open(path, "w") as f:
+        json.dump(results, f, indent=2)
+    print(json.dumps(results, indent=2))
+    print(f"wrote {path}")
+    return results
+
+
 def main():
+    if "--hotkeys" in sys.argv:
+        profile_hotkeys()
+        sys.exit(0)
     if "--quick" in sys.argv:
         results = {}
         ok, info = profile_resolution(results, quick=True)

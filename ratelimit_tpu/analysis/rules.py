@@ -1,13 +1,16 @@
-"""The initial tpu-lint rule pack.
+"""The tpu-lint rule pack.
 
-Four rules, each targeting a bug class that has no runtime guard in
-this repo (docs/STATIC_ANALYSIS.md describes each with examples):
+Each rule targets a bug class that has no runtime guard in this repo
+(docs/STATIC_ANALYSIS.md describes each with examples):
 
-- jax-host-sync:    host synchronization inside jit'd functions.
-- lock-discipline:  blocking calls under a held lock; attributes
-                    mutated both inside and outside lock scopes.
-- env-discipline:   os.environ reads outside settings.py / config/.
-- dtype-discipline: implicit dtype promotion in kernel scatter calls.
+- jax-host-sync:      host synchronization inside jit'd functions.
+- lock-discipline:    blocking calls under a held lock; attributes
+                      mutated both inside and outside lock scopes.
+- env-discipline:     os.environ reads outside settings.py / config/.
+- dtype-discipline:   implicit dtype promotion in kernel scatter calls.
+- timing-discipline:  time.time() in duration arithmetic.
+- metrics-discipline: interpolated (unbounded-cardinality) metric
+                      names in stats registrations.
 """
 
 from __future__ import annotations
@@ -593,6 +596,106 @@ class DtypeDisciplineRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# metrics-discipline
+# ---------------------------------------------------------------------------
+
+
+class MetricsDisciplineRule(Rule):
+    """F-string-interpolated metric names: the unbounded-cardinality
+    guard.
+
+    A ``store.counter(f"...{key}...")`` mints one Counter object and
+    one /metrics family PER DISTINCT VALUE of the interpolated
+    expression — a per-user or per-descriptor value there grows the
+    registry (and every scrape, and every statsd flush) without
+    bound.  Metric names must come from a bounded set: string
+    literals, ``base + ".suffix"`` over a bounded base, or the
+    sanctioned interning seams (stats/manager.py's per-rule scope
+    classes, which the config loader bounds), which are exempted by
+    path.  Traffic-shape questions ("which key is hot?") belong to
+    the hot-key sketch (observability/hotkeys.py), whose memory is
+    bounded by construction.
+
+    Flags direct f-string (and ``str.format``/percent-format)
+    arguments to the StatsStore registration methods on a
+    store-looking receiver.  Bounded interpolations (e.g. a lane
+    index) should bind the scope to a name first — that keeps the
+    bounded part visibly separate from the registration call — or
+    carry a justified suppression.
+    """
+
+    id = "metrics-discipline"
+    description = "interpolated metric name in a stats registration"
+    interests = (ast.Call,)
+
+    _REG_METHODS = {
+        "counter",
+        "gauge",
+        "timer",
+        "histogram",
+        "counter_fn",
+        "gauge_fn",
+    }
+    _ALLOWED_FRAGMENTS = ("stats/manager.py",)
+
+    def begin_file(self, ctx: FileContext) -> None:
+        path = ctx.path.replace("\\", "/")
+        self._exempt = any(f in path for f in self._ALLOWED_FRAGMENTS)
+
+    @staticmethod
+    def _is_storeish(node: ast.AST) -> bool:
+        name = terminal_name(node)
+        return name is not None and name.lower().endswith("store")
+
+    @staticmethod
+    def _interpolation_kind(node: ast.AST) -> Optional[str]:
+        """'f-string' / '.format()' / '%-format' when `node` builds a
+        string by interpolation, else None."""
+        if isinstance(node, ast.JoinedStr) and any(
+            isinstance(v, ast.FormattedValue) for v in node.values
+        ):
+            return "f-string"
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "format"
+            and isinstance(node.func.value, (ast.Constant, ast.JoinedStr))
+        ):
+            return ".format()"
+        if (
+            isinstance(node, ast.BinOp)
+            and isinstance(node.op, ast.Mod)
+            and isinstance(node.left, ast.Constant)
+            and isinstance(node.left.value, str)
+        ):
+            return "%-format"
+        return None
+
+    def visit(self, node, parents, ctx: FileContext) -> None:
+        if self._exempt:
+            return
+        f = node.func
+        if not (
+            isinstance(f, ast.Attribute)
+            and f.attr in self._REG_METHODS
+            and self._is_storeish(f.value)
+        ):
+            return
+        if not node.args:
+            return
+        kind = self._interpolation_kind(node.args[0])
+        if kind is not None:
+            self.report(
+                ctx,
+                node,
+                f"{kind} metric name in store.{f.attr}() mints one "
+                "metric per interpolated value (unbounded "
+                "cardinality); use a literal/bounded name, or the "
+                "hot-key sketch for per-key questions",
+            )
+
+
+# ---------------------------------------------------------------------------
 # timing-discipline
 # ---------------------------------------------------------------------------
 
@@ -700,6 +803,7 @@ def _make_default_rules() -> List[Rule]:
         EnvDisciplineRule(),
         DtypeDisciplineRule(),
         TimingDisciplineRule(),
+        MetricsDisciplineRule(),
     ]
 
 

@@ -374,6 +374,15 @@ class BatchDispatcher:
         # exactly as before.
         self.eager_idle = bool(eager_idle)
         self._inflight = 0  # launches handed to the completer, not yet done
+        self._inflight_hwm = 0  # high-water mark of the above
+        # Intake high-water mark, written only by the collector under
+        # the intake cv (one max() per drain swap, not per item).
+        self._queue_hwm = 0
+        # Batch-shape histograms (stats.Histogram or None), wired by
+        # TpuRateLimitCache.register_stats; observed once per launch
+        # on the collector thread.  Lanes/items counts, not ms.
+        self.batch_lanes_hist = None
+        self.batch_items_hist = None
         # Proactive slot-table gc: without it, expired keys linger in
         # the table until the free list empties (Redis expires keys
         # lazily too, but also actively samples; fixed 10-key-space
@@ -432,6 +441,23 @@ class BatchDispatcher:
         """Entries awaiting collection (stats gauge)."""
         return len(self._buf)
 
+    def queue_depth_hwm(self) -> int:
+        """Deepest intake drain seen (stats gauge): how far behind
+        the collector has ever been — the backpressure early-warning
+        the instantaneous queue_depth (usually 0 at scrape time)
+        cannot show."""
+        return self._queue_hwm
+
+    def inflight(self) -> int:
+        """Launches handed to the completer, not yet completed (the
+        completion-queue occupancy; capped at pipeline_depth)."""
+        return self._inflight
+
+    def inflight_hwm(self) -> int:
+        """High-water mark of in-flight launches: pipeline_depth is
+        saturated when this pins at the configured depth."""
+        return self._inflight_hwm
+
     def submit(self, item: WorkItem) -> None:
         self._enqueue(item)
 
@@ -487,6 +513,8 @@ class BatchDispatcher:
                                 return batch, tokens, stopping
                 drained = self._buf
                 self._buf = []
+                if len(drained) > self._queue_hwm:
+                    self._queue_hwm = len(drained)
 
             cut = None
             try:
@@ -534,12 +562,22 @@ class BatchDispatcher:
 
     def _launch(self, batch: List[WorkItem]) -> None:
         """Launch on the collector thread, hand to the completer."""
+        if self.batch_lanes_hist is not None:
+            # One observe per LAUNCH (not per item): a bisect + adds
+            # under the histogram lock, amortized across the batch.
+            self.batch_lanes_hist.observe(
+                sum(it.n_lanes for it in batch)
+            )
+        if self.batch_items_hist is not None:
+            self.batch_items_hist.observe(len(batch))
         token = submit_items(self.engine, batch)
         if token is _SUBMIT_FAILED:
             self._note_step(False)
         elif token is not None:
             with self._state_lock:
                 self._inflight += 1
+                if self._inflight > self._inflight_hwm:
+                    self._inflight_hwm = self._inflight
             self._put_completion(("batch", batch, token))
 
     def _put_completion(self, entry) -> None:

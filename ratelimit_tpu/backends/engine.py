@@ -317,6 +317,12 @@ class CounterEngine:
         # observers never call into the un-synchronized native table.
         self.stat_live_keys = 0
         self.stat_evictions = 0
+        # Fresh slot sightings = window rollovers: a key entering a
+        # new window is a new cache key whose first batch appearance
+        # carries fresh=1 (the lazy-expiry seam).  Counted per dedup
+        # GROUP so one rolled-over key counts once per batch, however
+        # many lanes repeat it.  Monotonic; exported as a counter.
+        self.stat_window_rollovers = 0
 
     # -- host-side key handling -----------------------------------------
 
@@ -379,6 +385,7 @@ class CounterEngine:
             )
             afters_dev, reassemble = self._device_submit(dedup)
             chunks.append((afters_dev, start, count, dedup, reassemble))
+            self.stat_window_rollovers += int(np.count_nonzero(dedup.fresh))
         self.stat_live_keys = len(self.slot_table)
         self.stat_evictions = self.slot_table.evictions
         return (batch.hits, batch.limits, batch.shadow, chunks)
@@ -471,6 +478,7 @@ class CounterEngine:
         for start, count, dedup in dedups:
             afters_dev, reassemble = self._device_submit(dedup)
             chunks.append((afters_dev, start, count, dedup, reassemble))
+            self.stat_window_rollovers += int(np.count_nonzero(dedup.fresh))
         self.stat_live_keys = len(table)
         self.stat_evictions = table.evictions
         return (hits, limits, shadow, chunks)

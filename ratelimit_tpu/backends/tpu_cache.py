@@ -37,7 +37,7 @@ import numpy as np
 
 from ..api import Code, DescriptorStatus, RateLimitRequest
 from ..config import RateLimitRule
-from ..observability import TRACER
+from ..observability import HotKeySketch, TRACER
 from ..limiter.cache_key import CacheKeyGenerator, EMPTY_KEY
 from ..limiter.local_cache import LocalCache
 from ..limiter.resolution import ResolutionCache
@@ -82,6 +82,7 @@ class TpuRateLimitCache:
         pipeline_depth: int = 2,
         unhealthy_after: int = 3,
         resolution_cache_entries: int = 1 << 16,
+        hotkeys_top_k: int = 0,
     ):
         """`engine` may be a LIST of engines: N independent host LANES,
         each with its own slot table, dispatcher thread pair, and
@@ -119,6 +120,21 @@ class TpuRateLimitCache:
             )
             if resolution_cache_entries > 0
             else None
+        )
+        # Hot-key sketch (observability/hotkeys.py): Space-Saving
+        # top-K over interned descriptor stems, fed by the resolution
+        # fast path below (one counter bump per descriptor on a
+        # pre-resolved handle).  0 disables; requires the resolver
+        # (the handle lives on its entries).
+        self.hotkeys = (
+            HotKeySketch(hotkeys_top_k)
+            if hotkeys_top_k > 0 and self.resolver is not None
+            else None
+        )
+        # Near-limit threshold ratio for the sketch's outcome shares
+        # (mirrors the engines' decide threshold).
+        self._near_ratio = float(
+            getattr(lanes[0].model, "near_ratio", 0.8)
         )
         self.expiration_jitter_max_seconds = int(expiration_jitter_max_seconds)
         self.jitter_rand = jitter_rand or random.Random()
@@ -263,7 +279,8 @@ class TpuRateLimitCache:
         .generate and _make_item's per-lane loop all collapse here.
 
         Returns (items, statuses, categories, keys, limits,
-        is_unlimited, hits_addend, now)."""
+        is_unlimited, hits_addend, now, hot) — ``hot`` is the per-row
+        hot-key entry list (None when the sketch is disabled)."""
         resolver = self.resolver
         descriptors = request.descriptors
         domain = request.domain
@@ -290,6 +307,16 @@ class TpuRateLimitCache:
             add_tpl = tp0.append
         local_cache = self.local_cache
         resolve = resolver.resolve
+        # Hot-key sketch feed: one counter bump per limited descriptor
+        # on the handle pinned to its ResolvedDescriptor; track() (the
+        # locked, structural path) only runs on first sight of a stem
+        # or after a sketch eviction killed the handle.  Overrides
+        # (request-supplied limits) bypass the resolver and are not
+        # tracked.  ``hot`` rides back so do_limit_resolved can fold
+        # the request's over/near-limit outcomes into the entries.
+        hk = self.hotkeys
+        hot: Optional[list] = [None] * n if hk is not None else None
+        hk_observed = 0  # batched into hk.observed after the loop
         # Inlined resolve() hit path: one dict probe + generation
         # check per descriptor, with the hit tally batched into one
         # attribute add per request.  Misses (and their counting) go
@@ -326,6 +353,14 @@ class TpuRateLimitCache:
                 is_unlimited[i] = True
                 continue  # limits[i] stays None (service contract)
             limits[i] = rule
+            if hk is not None:
+                e = rd.hot
+                if e is None or e.key is None:
+                    e = hk.track(rd.stem)
+                    rd.hot = e
+                e.hits += hits_addend
+                hk_observed += hits_addend
+                hot[i] = e
             if rule is prev_rule:
                 prev_hits += hits_addend
             else:
@@ -361,6 +396,8 @@ class TpuRateLimitCache:
             prev_rule.stats.total_hits.add(prev_hits)
         if resolution_hits:
             resolver.hits += resolution_hits
+        if hk_observed:
+            hk.observed += hk_observed
 
         if overrides is not None:
             self._route_overrides(
@@ -405,7 +442,10 @@ class TpuRateLimitCache:
                     ),
                 )
             )
-        return items, statuses, categories, keys, limits, is_unlimited, hits_addend, now
+        return (
+            items, statuses, categories, keys, limits, is_unlimited,
+            hits_addend, now, hot,
+        )
 
     def _route_overrides(
         self,
@@ -501,12 +541,44 @@ class TpuRateLimitCache:
             is_unlimited,
             hits_addend,
             now,
+            hot,
         ) = self._prepare_resolved(request, config)
         statuses = self._execute(
             limits, items, statuses, categories, hits_addend, now,
             len(request.descriptors),
         )
+        if hot is not None:
+            self._note_hotkey_outcomes(hot, statuses, limits, hits_addend)
         return statuses, limits, is_unlimited
+
+    def _note_hotkey_outcomes(
+        self, hot, statuses, limits, hits_addend: int
+    ) -> None:
+        """Fold this request's decisions into its hot-key entries:
+        over-limit hits by status code, near-limit hits by the decide
+        threshold (``after > floor(limit * near_ratio)``, recovered
+        from limit_remaining for OK statuses).  Request-granular — a
+        hits_addend spanning the threshold attributes wholly, which is
+        exact enough for a sketch whose estimates already carry error
+        bounds.  Lock-free bumps; see observability/hotkeys.py."""
+        ratio = self._near_ratio
+        over = Code.OVER_LIMIT
+        for i, e in enumerate(hot):
+            if e is None:
+                continue
+            st = statuses[i]
+            if st.code is over:
+                e.over_limit += hits_addend
+            else:
+                lim = st.current_limit
+                if lim is not None:
+                    rpu = lim.requests_per_unit
+                    # after > limit * ratio (float compare; matches the
+                    # decide threshold for every practically reachable
+                    # limit — exactness to the device's float32 floor
+                    # is not a sketch property).
+                    if rpu - st.limit_remaining > rpu * ratio:
+                        e.near_limit += hits_addend
 
     def _execute(
         self,
@@ -657,12 +729,20 @@ class TpuRateLimitCache:
         for d in dispatchers:
             d.stop()
 
+    # Batch-size histogram ladder: powers of two up to the default
+    # batch limit (these histograms count lanes/items, not ms).
+    _BATCH_BOUNDS = tuple(float(1 << i) for i in range(13))
+
     def register_stats(self, store, scope: str = "ratelimit.tpu") -> None:
-        """Live gauges for each bank (slot-table occupancy/evictions,
-        dispatcher queue depth) — the analog of the reference's redis
-        pool gauges (driver_impl.go:17-29) — plus the resolution/stem
-        cache counters, so a key-cardinality blowup (clears climbing,
-        hit rate collapsing) is visible on /metrics instead of silent."""
+        """Live gauges for each bank (slot-table occupancy/evictions/
+        fill, dispatcher queue depth + high-water marks, in-flight
+        launches, batch-shape histograms, window rollovers) — the
+        analog of the reference's redis pool gauges
+        (driver_impl.go:17-29) — plus the resolution/stem cache
+        counters and the hot-key sketch family, so a key-cardinality
+        blowup (clears climbing, hit rate collapsing) or an
+        approaching slot-table exhaustion (fill_pct, evictions) is
+        visible on /metrics instead of silent."""
         kg = self.key_generator
         store.counter_fn(scope + ".stem_cache_clears", lambda: kg.clears)
         store.gauge_fn(scope + ".stem_cache.entries", lambda: len(kg))
@@ -680,22 +760,60 @@ class TpuRateLimitCache:
             store.gauge_fn(
                 scope + ".resolution_cache.entries", lambda: len(res)
             )
+        if self.hotkeys is not None:
+            self.hotkeys.register_stats(store, scope + ".hotkeys")
         for idx, engine in enumerate(self.engines()):
             base = f"{scope}.bank{idx}"
             # Cached snapshots updated by the table-owning thread —
             # never call into the (unsynchronized) native table from
             # observer threads.
             store.gauge_fn(base + ".live_keys", lambda e=engine: e.stat_live_keys)
-            store.gauge_fn(
+            # Evictions are monotonic — a counter (paired with the
+            # num_slots capacity gauge below, so "about to exhaust
+            # TPU_NUM_SLOTS" is a dashboard alert, not a runtime
+            # error surprise).  Window rollovers likewise count fresh
+            # slot sightings (a new window's first batch appearance).
+            store.counter_fn(
                 base + ".evictions", lambda e=engine: e.stat_evictions
+            )
+            store.counter_fn(
+                base + ".window_rollovers",
+                lambda e=engine: e.stat_window_rollovers,
             )
             store.gauge_fn(
                 base + ".num_slots", lambda e=engine: e.model.num_slots
+            )
+            store.gauge_fn(
+                base + ".slot_fill_pct",
+                lambda e=engine: (
+                    100 * e.stat_live_keys // max(1, e.model.num_slots)
+                ),
             )
             d = self._dispatchers.get(id(engine))
             if d is not None:
                 store.gauge_fn(
                     base + ".dispatch_queue", lambda dd=d: dd.queue_depth()
+                )
+                store.gauge_fn(
+                    base + ".dispatch_queue_hwm",
+                    lambda dd=d: dd.queue_depth_hwm(),
+                )
+                store.gauge_fn(
+                    base + ".inflight_launches", lambda dd=d: dd.inflight()
+                )
+                store.gauge_fn(
+                    base + ".inflight_hwm", lambda dd=d: dd.inflight_hwm()
+                )
+                # Batch-shape histograms, observed once per launch on
+                # the collector thread (dispatcher._launch): lanes per
+                # device batch and work items per batch — the data for
+                # tuning TPU_BATCH_WINDOW_US / TPU_BATCH_LIMIT /
+                # TPU_NUM_LANES from dashboards.
+                d.batch_lanes_hist = store.histogram(
+                    base + ".batch_lanes", self._BATCH_BOUNDS
+                )
+                d.batch_items_hist = store.histogram(
+                    base + ".batch_items", self._BATCH_BOUNDS
                 )
 
     def engines(self):

@@ -400,12 +400,31 @@ class WriteBehindRateLimitCache:
     def register_stats(self, store, scope: str = "ratelimit.tpu") -> None:
         base = scope + ".bank0"
         store.gauge_fn(base + ".live_keys", lambda: self.engine.stat_live_keys)
-        store.gauge_fn(base + ".evictions", lambda: self.engine.stat_evictions)
+        # Counter + capacity gauge pair (same surface as tpu_cache):
+        # slot exhaustion becomes a dashboard trend, not a surprise.
+        store.counter_fn(
+            base + ".evictions", lambda: self.engine.stat_evictions
+        )
+        store.counter_fn(
+            base + ".window_rollovers",
+            lambda: self.engine.stat_window_rollovers,
+        )
         store.gauge_fn(
             base + ".num_slots", lambda: self.engine.model.num_slots
         )
         store.gauge_fn(
+            base + ".slot_fill_pct",
+            lambda: (
+                100 * self.engine.stat_live_keys
+                // max(1, self.engine.model.num_slots)
+            ),
+        )
+        store.gauge_fn(
             base + ".dispatch_queue", lambda: self._dispatcher.queue_depth()
+        )
+        store.gauge_fn(
+            base + ".dispatch_queue_hwm",
+            lambda: self._dispatcher.queue_depth_hwm(),
         )
         store.gauge_fn(
             scope + ".host_view_keys", lambda: len(self._view)

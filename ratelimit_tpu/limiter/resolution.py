@@ -110,6 +110,7 @@ class ResolvedDescriptor:
         "divider",
         "_lane_dtype",
         "_win",
+        "hot",
     )
 
     def __init__(self, generation: int, rule, stem: str, n_lanes: int, lane_dtype):
@@ -122,6 +123,11 @@ class ResolvedDescriptor:
         self.lane = crc32(self.stem_bytes) % n_lanes if n_lanes > 1 else 0
         self._lane_dtype = lane_dtype
         self._win: Optional[WindowState] = None
+        # Hot-key sketch handle (observability/hotkeys.py), pinned by
+        # the serving loop on first observation so the per-request
+        # cost is one counter bump — None until tracked, and the
+        # handle itself goes dead (key=None) on sketch eviction.
+        self.hot = None
         if rule is not None and not rule.unlimited:
             self.unit = rule.limit.unit
             self.divider = unit_to_divider(self.unit)

@@ -4,8 +4,11 @@
                   JSONL/log exporters, and the process-wide TRACER.
 - ``prometheus``: text exposition for ``GET /metrics``.
 - ``tracez``:     ``GET /debug/tracez`` rendering.
+- ``hotkeys``:    Space-Saving top-K sketch of the hottest descriptor
+                  stems (``GET /debug/hotkeys``).
 """
 
+from .hotkeys import HotKeyEntry, HotKeySketch
 from .trace import (
     NOOP_SPAN,
     TRACEPARENT_HEADER,
@@ -24,6 +27,8 @@ __all__ = [
     "NOOP_SPAN",
     "TRACEPARENT_HEADER",
     "FinishedTrace",
+    "HotKeyEntry",
+    "HotKeySketch",
     "JsonlExporter",
     "Span",
     "SpanContext",

@@ -147,6 +147,7 @@ def create_limiter(s: Settings, stats_manager: Manager, local_cache, time_source
             pipeline_depth=s.tpu_pipeline_depth,
             unhealthy_after=s.tpu_unhealthy_after,
             resolution_cache_entries=s.resolution_cache_entries,
+            hotkeys_top_k=s.hotkeys_top_k,
         )
     raise ValueError(f"Invalid setting for BackendType: {s.backend_type}")
 
@@ -325,7 +326,12 @@ class Runner:
         self.http_server.start()
 
         self.debug_server = HttpServer(s.debug_host, s.debug_port, name="debug")
-        add_debug_routes(self.debug_server, self.stats_manager.store, self.service)
+        add_debug_routes(
+            self.debug_server,
+            self.stats_manager.store,
+            self.service,
+            profiling_enabled=s.debug_profiling,
+        )
         add_healthcheck(self.debug_server, self.health)
         self.debug_server.start()
 

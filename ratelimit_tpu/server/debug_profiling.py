@@ -111,10 +111,18 @@ def sample_cpu_profile(seconds: float, hz: int = 100) -> str:
 
 
 def add_profiling_routes(
-    server, artifacts_dir: Optional[str] = None
+    server,
+    artifacts_dir: Optional[str] = None,
+    profiling_enabled: bool = False,
 ) -> None:
     """Mount /debug/threadz, /debug/profile, /debug/xla_trace (and a
-    /debug/pprof/ index pointing at them)."""
+    /debug/pprof/ index pointing at them).
+
+    The two CAPTURE endpoints (profile, xla_trace) are refused with
+    403 unless ``profiling_enabled`` (the DEBUG_PROFILING setting):
+    both burn CPU / write artifacts in the live serving process, so
+    they are an explicit operator opt-in, guarded one-capture-at-a-
+    time.  threadz (a point-in-time stack read) stays always-on."""
     # tempfile.gettempdir() honors TMPDIR without a direct env read
     # (env-discipline: env vars become config in settings.py only).
     artifacts = artifacts_dir or os.path.join(
@@ -133,12 +141,33 @@ def add_profiling_routes(
     def threadz(h) -> None:
         h._reply(200, threadz_text().encode())
 
+    def _gate(h) -> bool:
+        if profiling_enabled:
+            return True
+        h._reply(
+            403,
+            b"profiling captures are disabled; start the server with "
+            b"DEBUG_PROFILING=1 to enable /debug/profile and "
+            b"/debug/xla_trace\n",
+        )
+        return False
+
     def profile(h) -> None:
+        if not _gate(h):
+            return
         seconds = _q(h, "seconds", 2.0, 0.1, 60.0)
         hz = int(_q(h, "hz", 100.0, 1.0, 1000.0))
-        h._reply(200, sample_cpu_profile(seconds, hz).encode())
+        if not trace_lock.acquire(blocking=False):
+            h._reply(409, b"a capture is already running\n")
+            return
+        try:
+            h._reply(200, sample_cpu_profile(seconds, hz).encode())
+        finally:
+            trace_lock.release()
 
     def xla_trace(h) -> None:
+        if not _gate(h):
+            return
         seconds = _q(h, "seconds", 1.0, 0.1, 60.0)
         if not trace_lock.acquire(blocking=False):
             h._reply(409, b"a trace capture is already running\n")
@@ -176,9 +205,12 @@ def add_profiling_routes(
             200,
             b"live introspection endpoints (Go pprof analogs):\n"
             b"  /debug/threadz              all-thread stack dump\n"
-            b"  /debug/profile?seconds=N    statistical CPU profile\n"
-            b"  /debug/xla_trace?seconds=N  jax.profiler trace capture\n"
+            b"  /debug/profile?seconds=N    statistical CPU profile"
+            b" (DEBUG_PROFILING=1)\n"
+            b"  /debug/xla_trace?seconds=N  jax.profiler trace capture"
+            b" (DEBUG_PROFILING=1)\n"
             b"  /debug/tracez               slowest + recent request traces\n"
+            b"  /debug/hotkeys              top-K hottest descriptor stems\n"
             b"  /stats                      counters/gauges/timers/histograms\n"
             b"  /metrics                    Prometheus text exposition\n",
         )

@@ -13,11 +13,15 @@ Debug server routes (server_impl.go:238-269, runner.go:117-124):
 - GET /metrics          Prometheus text exposition (scrape target)
 - GET /rlconfig         current config dump
 - GET /debug/tracez     slowest + most recent request traces
+- GET /debug/hotkeys    Space-Saving top-K of the hottest descriptor
+                        stems (JSON; estimated hits, error bound,
+                        over/near-limit share)
 - GET /debug/pprof/     index of the live-introspection endpoints
 - GET /debug/threadz    all-thread stack dump
-- GET /debug/profile    statistical all-thread CPU profile
-- GET /debug/xla_trace  jax.profiler trace capture
-(see server/debug_profiling.py and docs/OBSERVABILITY.md)
+- GET /debug/profile    statistical all-thread CPU profile   (gated)
+- GET /debug/xla_trace  jax.profiler trace capture            (gated)
+(capture endpoints require DEBUG_PROFILING=1; see
+server/debug_profiling.py and docs/OBSERVABILITY.md)
 """
 
 from __future__ import annotations
@@ -205,8 +209,12 @@ def add_healthcheck(server: HttpServer, health: HealthChecker) -> None:
     server.add_route("GET", "/healthcheck", handle)
 
 
-def add_debug_routes(server: HttpServer, store, service=None) -> None:
-    """/stats and /rlconfig (server_impl.go:254-261, runner.go:117-124)."""
+def add_debug_routes(
+    server: HttpServer, store, service=None, profiling_enabled: bool = False
+) -> None:
+    """/stats, /rlconfig, /metrics, /debug/* (server_impl.go:254-261,
+    runner.go:117-124).  ``profiling_enabled`` (the DEBUG_PROFILING
+    setting) opens the capture endpoints in debug_profiling.py."""
 
     def stats(h) -> None:
         lines = []
@@ -251,10 +259,30 @@ def add_debug_routes(server: HttpServer, store, service=None) -> None:
     def tracez(h) -> None:
         h._reply(200, _tracez.render(TRACER).encode())
 
+    def hotkeys(h) -> None:
+        # Traffic-shape zPage (docs/OBSERVABILITY.md): the backend's
+        # Space-Saving sketch of the hottest descriptor stems.
+        # Resolved per request so the handler works however the cache
+        # is wired (and 404s cleanly when tracking is off).
+        sketch = getattr(getattr(service, "cache", None), "hotkeys", None)
+        if sketch is None:
+            h._reply(
+                404,
+                b"hot-key tracking disabled (HOTKEYS_TOP_K=0 or "
+                b"backend without a resolution fast path)\n",
+            )
+            return
+        h._reply(
+            200,
+            json.dumps(sketch.snapshot_dict()).encode(),
+            content_type="application/json",
+        )
+
     server.add_route("GET", "/stats", stats)
     server.add_route("GET", "/stats.json", stats_json)
     server.add_route("GET", "/metrics", metrics)
     server.add_route("GET", "/debug/tracez", tracez)
+    server.add_route("GET", "/debug/hotkeys", hotkeys)
 
     if service is not None:
 
@@ -269,4 +297,4 @@ def add_debug_routes(server: HttpServer, store, service=None) -> None:
     # (the net-http-pprof analog, reference server_impl.go:238-269).
     from .debug_profiling import add_profiling_routes
 
-    add_profiling_routes(server)
+    add_profiling_routes(server, profiling_enabled=profiling_enabled)

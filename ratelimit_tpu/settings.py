@@ -212,6 +212,18 @@ class Settings:
     # disabled.
     tpu_compile_cache_dir: str = ""
 
+    # Hot-key tracking (observability/hotkeys.py): capacity of the
+    # Space-Saving top-K sketch over descriptor stems, exposed as
+    # GET /debug/hotkeys + the bounded ratelimit.tpu.hotkeys.* metric
+    # family.  0 disables (and the hot path pays nothing).  Only the
+    # tpu / tpu-sharded backends (the resolution fast path) feed it.
+    hotkeys_top_k: int = 128
+    # On-demand capture endpoints (/debug/profile statistical CPU
+    # profile, /debug/xla_trace jax.profiler capture) are disabled
+    # unless this is set: both sample/trace the LIVE serving process,
+    # which is an operator action, not a default-open surface.
+    debug_profiling: bool = False
+
     # Request tracing (observability/trace.py; docs/OBSERVABILITY.md).
     # Head-sampling probability for traces with no inbound traceparent
     # (an inbound sampled flag always wins); 0.0 = only errors and
@@ -294,6 +306,8 @@ def new_settings() -> Settings:
         tpu_checkpoint_dir=_env_str("TPU_CHECKPOINT_DIR", ""),
         tpu_checkpoint_interval_s=_env_float("TPU_CHECKPOINT_INTERVAL_S", 30.0),
         tpu_compile_cache_dir=_env_str("TPU_COMPILE_CACHE_DIR", ""),
+        hotkeys_top_k=_env_int("HOTKEYS_TOP_K", 128),
+        debug_profiling=_env_bool("DEBUG_PROFILING", False),
         trace_sample_rate=_env_float("TRACE_SAMPLE_RATE", 0.0),
         trace_sample_errors=_env_bool("TRACE_SAMPLE_ERRORS", True),
         trace_ring_size=_env_int("TRACE_RING_SIZE", 256),

@@ -296,8 +296,9 @@ class StatsStore:
         gauge_fn pattern for monotonically increasing tallies kept as
         plain ints by their owner — e.g. the resolution/stem cache
         hit counts, which deliberately avoid a per-request Lock).
-        Rendered with counter type on /metrics; not drained to statsd
-        (the statsd sink only flushes delta-tracking Counter objects)."""
+        Rendered with counter type on /metrics; the statsd exporter
+        delta-tracks them itself (StatsdExporter._fn_last) since,
+        unlike Counter objects, they carry no drain cursor."""
         with self._lock:
             self._counter_fns[name] = fn
 
@@ -308,6 +309,14 @@ class StatsStore:
         for name, fn in fns:
             out[name] = int(fn())
         return out
+
+    def counter_fn_values(self) -> Dict[str, int]:
+        """Just the fn-backed counters (statsd export: the exporter
+        delta-tracks these itself, since live Counter objects carry
+        their own drain cursor but plain-int owners cannot)."""
+        with self._lock:
+            fns = list(self._counter_fns.items())
+        return {name: int(fn()) for name, fn in fns}
 
     def gauge_fn(self, name: str, fn) -> None:
         """Register a live gauge evaluated at snapshot time (reference

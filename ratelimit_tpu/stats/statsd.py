@@ -57,6 +57,12 @@ class StatsdExporter:
         self._closed = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Delta cursors for fn-backed counters (gauge_fn-style live
+        # counters: resolution cache hits, slot-table evictions, the
+        # hot-key sketch tallies).  Live Counter objects drain their
+        # own deltas; these are plain ints read at flush time, so the
+        # exporter keeps the last-flushed value per name.
+        self._fn_last: dict = {}
 
     def _resolve_srv(self) -> Tuple[str, int]:
         from ..utils.srv import server_strings_from_srv
@@ -117,6 +123,11 @@ class StatsdExporter:
             delta = c.drain_delta()
             if delta:
                 lines.append(f"{c.name}:{delta}|c")
+        for name, value in self.store.counter_fn_values().items():
+            delta = value - self._fn_last.get(name, 0)
+            self._fn_last[name] = value
+            if delta > 0:  # benign races can read a tally mid-step
+                lines.append(f"{name}:{delta}|c")
         for name, value in self.store.gauges().items():
             lines.append(f"{name}:{value}|g")
         for t in timers:

@@ -1,10 +1,18 @@
 """CI smoke for the observability surfaces (`make metrics-smoke`).
 
 Boots a real Runner in-process (CPU backend path, ephemeral ports),
-pushes one traced request through the full gRPC stack, then asserts:
+pushes one traced request plus a burst of SKEWED traffic through the
+full gRPC stack, then asserts:
 
 - GET /metrics serves well-formed Prometheus text: TYPE lines, per-
   phase histograms with cumulative buckets, +Inf == _count;
+- the device-path and traffic-shape families render: dispatcher
+  queue gauges + high-water marks, slot-table capacity/fill/
+  evictions/rollovers, batch-shape histograms, hotkeys family;
+- GET /debug/hotkeys ranks the injected heavy hitter first;
+- GET /debug/profile?seconds=1 (DEBUG_PROFILING on) round-trips and
+  the server still serves afterwards — a wedged capture lock or a
+  blocked listener would fail here, not in production;
 - GET /debug/tracez shows the request's trace (the inbound traceparent
   id) with the kernel-phase span.
 
@@ -65,16 +73,21 @@ def main() -> int:
                 runtime_subdirectory="ratelimit",
                 local_cache_size_in_bytes=0,
                 expiration_jitter_max_seconds=0,
+                hotkeys_top_k=8,
+                debug_profiling=True,
             )
         )
         runner.start()
         try:
             trace_id = "5a" * 16
             header = f"00-{trace_id}-{'6b' * 8}-01"
-            req = rls_pb2.RateLimitRequest(domain="smoke")
-            d = req.descriptors.add()
-            e = d.entries.add()
-            e.key, e.value = "k", "smoke"
+            def request_for(value: str) -> "rls_pb2.RateLimitRequest":
+                req = rls_pb2.RateLimitRequest(domain="smoke")
+                d = req.descriptors.add()
+                e = d.entries.add()
+                e.key, e.value = "k", value
+                return req
+
             with grpc.insecure_channel(
                 f"127.0.0.1:{runner.grpc_server.bound_port}"
             ) as channel:
@@ -87,15 +100,23 @@ def main() -> int:
                     response_deserializer=rls_pb2.RateLimitResponse.FromString,
                 )
                 resp = method(
-                    req, timeout=60, metadata=[("traceparent", header)]
+                    request_for("smoke"),
+                    timeout=60,
+                    metadata=[("traceparent", header)],
                 )
-            assert resp.overall_code == rls_pb2.RateLimitResponse.OK, resp
+                assert resp.overall_code == rls_pb2.RateLimitResponse.OK, resp
+                # Skewed traffic: one heavy hitter, a cold tail — the
+                # hot-key sketch must rank the injected hot key first.
+                for _ in range(12):
+                    method(request_for("hotkey"), timeout=60)
+                for i in range(3):
+                    method(request_for(f"cold{i}"), timeout=60)
 
             debug = runner.debug_server.bound_port
 
-            def get(path: str) -> str:
+            def get(path: str, port: int = 0) -> str:
                 with urllib.request.urlopen(
-                    f"http://127.0.0.1:{debug}{path}", timeout=30
+                    f"http://127.0.0.1:{port or debug}{path}", timeout=30
                 ) as r:
                     assert r.status == 200, (path, r.status)
                     return r.read().decode()
@@ -122,6 +143,52 @@ def main() -> int:
             )
             assert buckets == sorted(buckets), "buckets not cumulative"
             assert buckets[-1] == count >= 1, (buckets, count)
+
+            # Device-path + traffic-shape families (PR: hot-key sketch,
+            # lane/queue/slot-table gauges).
+            for family in (
+                "ratelimit_tpu_bank0_dispatch_queue",
+                "ratelimit_tpu_bank0_dispatch_queue_hwm",
+                "ratelimit_tpu_bank0_inflight_launches",
+                "ratelimit_tpu_bank0_num_slots",
+                "ratelimit_tpu_bank0_slot_fill_pct",
+                "ratelimit_tpu_bank0_evictions",
+                "ratelimit_tpu_bank0_window_rollovers",
+                "ratelimit_tpu_bank0_batch_lanes_bucket",
+                "ratelimit_tpu_bank0_batch_items_bucket",
+                "ratelimit_tpu_hotkeys_tracked",
+                "ratelimit_tpu_hotkeys_observed",
+            ):
+                assert family in metrics, family
+
+            hot = json.loads(get("/debug/hotkeys"))
+            assert hot["capacity"] == 8 and hot["tracked"] >= 4, hot
+            top = hot["keys"][0]
+            assert top["key"] == "smoke_k_hotkey_", hot["keys"][:3]
+            assert top["hits"] >= 12, top
+            ranked = [k["hits"] for k in hot["keys"]]
+            assert ranked == sorted(ranked, reverse=True), ranked
+
+            # On-demand capture round-trip (DEBUG_PROFILING=1): a
+            # 1-second statistical profile must come back well-formed
+            # and leave the server serving (capture lock released).
+            profile = get("/debug/profile?seconds=1")
+            assert "statistical cpu profile" in profile, profile[:200]
+            health = get("/healthcheck", port=runner.http_server.bound_port)
+            assert health == "OK", health
+            with grpc.insecure_channel(
+                f"127.0.0.1:{runner.grpc_server.bound_port}"
+            ) as channel:
+                method = channel.unary_unary(
+                    "/envoy.service.ratelimit.v3.RateLimitService/"
+                    "ShouldRateLimit",
+                    request_serializer=(
+                        rls_pb2.RateLimitRequest.SerializeToString
+                    ),
+                    response_deserializer=rls_pb2.RateLimitResponse.FromString,
+                )
+                resp = method(request_for("after-profile"), timeout=60)
+            assert resp.overall_code == rls_pb2.RateLimitResponse.OK, resp
 
             tracez = get("/debug/tracez")
             assert trace_id in tracez, tracez

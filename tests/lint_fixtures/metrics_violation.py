@@ -1,0 +1,23 @@
+"""Seeded metrics-discipline violations (and near-misses that must
+stay quiet)."""
+
+
+def bad(store, user_id, lane):
+    store.counter(f"ratelimit.user.{user_id}.hits").inc()  # line 6: flag
+    store.gauge(f"lane{lane}.depth").set(1)  # line 7: flag
+    store.histogram("rl.{}.ms".format(user_id))  # line 8: flag
+    store.gauge_fn("rl.lane%d.depth" % lane, lambda: 0)  # line 9: flag
+
+
+def fine(store, stats_store, lane):
+    base = f"ratelimit.tpu.bank{lane}"  # bounded scope bound to a name
+    store.counter(base + ".total_hits").inc()
+    stats_store.gauge("ratelimit.tpu.queue_depth").set(0)
+    store.histogram("ratelimit.server.response_ms")
+    # Not a store receiver: unrelated APIs may interpolate freely.
+    logger = store
+    del logger
+
+
+def not_a_store(registry, user_id):
+    registry.counter(f"per-user.{user_id}")  # receiver not store-ish

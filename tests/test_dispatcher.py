@@ -149,6 +149,51 @@ def test_lane_limit_caps_batch():
         d.stop()
 
 
+def test_dispatcher_telemetry_hwm_and_batch_histograms():
+    """queue/in-flight high-water marks advance and the batch-shape
+    histograms observe one sample per LAUNCH (lanes and items)."""
+    from ratelimit_tpu.stats.manager import Histogram
+
+    engine = CounterEngine(num_slots=64, buckets=(8, 32))
+    d = BatchDispatcher(engine, batch_window_us=100_000, batch_limit=2)
+    d.batch_lanes_hist = Histogram(
+        "test.batch_lanes", bounds=(1.0, 2.0, 4.0, 8.0)
+    )
+    d.batch_items_hist = Histogram(
+        "test.batch_items", bounds=(1.0, 2.0, 4.0, 8.0)
+    )
+    try:
+        assert d.queue_depth_hwm() == 0 and d.inflight_hwm() == 0
+        items = [
+            WorkItem(
+                now=0,
+                lanes=[
+                    Lane(key=f"k{i}_0", expiry=60, limit=10, shadow=False, hits=1)
+                ],
+                apply=lambda dec: None,
+            )
+            for i in range(4)
+        ]
+        for it in items:
+            d.submit(it)
+        for it in items:
+            it.wait()
+        d.flush()
+        # 4 single-lane items through a 2-lane cap: two+ launches of
+        # <=2 lanes each, every lane/item accounted exactly once.
+        lanes = d.batch_lanes_hist.summary()
+        batches = d.batch_items_hist.summary()
+        assert lanes["total_ms"] == 4.0  # sum of observed lane counts
+        assert batches["total_ms"] == 4.0
+        assert lanes["count"] == batches["count"] >= 2
+        assert lanes["max_ms"] <= 2.0
+        assert d.queue_depth_hwm() >= 1
+        assert 1 <= d.inflight_hwm() <= 2
+        assert d.inflight() == 0  # all completed
+    finally:
+        d.stop()
+
+
 def test_engine_error_propagates_as_cache_error(clock):
     class BrokenEngine(CounterEngine):
         def submit_packed(self, *args, **kwargs):

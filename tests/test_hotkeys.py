@@ -1,0 +1,339 @@
+"""Hot-key tracking: Space-Saving sketch invariants (exact top-K on
+skewed streams, estimate/error bounds, eviction at capacity,
+concurrency), the serving-path feed through do_limit_resolved
+(decision parity, over/near-limit shares, handle revival after
+eviction), the /debug/hotkeys JSON surface, and the bounded
+ratelimit.tpu.hotkeys.* metric family."""
+
+import json
+import threading
+import urllib.request
+from collections import Counter
+
+import pytest
+
+from ratelimit_tpu.observability import HotKeySketch
+
+
+# -- sketch invariants (single-writer feed) ----------------------------------
+
+
+def feed(sketch, stream, hits=1):
+    for key in stream:
+        e = sketch.track(key)
+        e.hits += hits
+        sketch.observed += hits
+
+
+def skewed_stream(seed=7, n=20_000, heavy=("hot-a", "hot-b", "hot-c")):
+    """A synthetic zipf-ish stream: 3 heavy hitters carry ~60% of the
+    traffic, a long tail of 2000 keys carries the rest."""
+    import random
+
+    rng = random.Random(seed)
+    out = []
+    for _ in range(n):
+        if rng.random() < 0.6:
+            out.append(rng.choice(heavy))
+        else:
+            out.append(f"tail-{rng.randrange(2000)}")
+    return out
+
+
+def test_exact_counts_when_under_capacity():
+    sketch = HotKeySketch(capacity=16)
+    stream = ["a"] * 5 + ["b"] * 3 + ["c"] * 1
+    feed(sketch, stream)
+    snap = {e["key"]: e for e in sketch.snapshot()}
+    assert snap["a"]["hits"] == 5 and snap["a"]["error"] == 0
+    assert snap["b"]["hits"] == 3 and snap["c"]["hits"] == 1
+    assert sketch.evictions == 0
+    assert sketch.observed == 9
+
+
+def test_top_k_on_skewed_stream():
+    """The heavy hitters must rank first (Space-Saving guarantee: any
+    key with true count > N/capacity is tracked; the top of the
+    summary is the top of the stream)."""
+    stream = skewed_stream()
+    sketch = HotKeySketch(capacity=64)
+    feed(sketch, stream)
+    top3 = [e["key"] for e in sketch.snapshot(3)]
+    assert sorted(top3) == ["hot-a", "hot-b", "hot-c"]
+    # Ordered by true frequency too.
+    true = Counter(stream)
+    assert top3 == sorted(top3, key=lambda k: -true[k])
+
+
+def test_error_bound_invariant():
+    """estimate >= true count >= estimate - error, for every tracked
+    key, on a stream that forces heavy eviction churn."""
+    stream = skewed_stream(seed=11, n=30_000)
+    sketch = HotKeySketch(capacity=32)
+    feed(sketch, stream)
+    true = Counter(stream)
+    snap = sketch.snapshot()
+    assert len(snap) <= 32
+    for e in snap:
+        assert e["hits"] >= true[e["key"]], e
+        assert e["hits"] - e["error"] <= true[e["key"]], e
+    # The summary's total estimate can never exceed the stream length
+    # plus inherited error mass; observed is exact.
+    assert sketch.observed == len(stream)
+
+
+def test_eviction_at_capacity_inherits_min_and_kills_handle():
+    sketch = HotKeySketch(capacity=2)
+    a = sketch.track("a")
+    a.hits += 10
+    b = sketch.track("b")
+    b.hits += 3
+    c = sketch.track("c")  # evicts b (the minimum)
+    assert sketch.evictions == 1
+    assert b.key is None  # dead handle: holders must re-track
+    assert c.key == "c"
+    assert c.hits == 3 and c.error == 3  # inherited min count
+    assert len(sketch) == 2
+    # A bump on the dead handle is lost, never misattributed.
+    b.hits += 100
+    assert {e["key"] for e in sketch.snapshot()} == {"a", "c"}
+    assert all(e["hits"] <= 13 for e in sketch.snapshot())
+
+
+def test_track_is_idempotent_and_refreshes_key_reference():
+    sketch = HotKeySketch(capacity=4)
+    base = "domain_key_value_"
+    e1 = sketch.track(base)
+    fresh = "".join(["domain_", "key_", "value_"])
+    assert fresh == base and fresh is not base  # equal, distinct object
+    e2 = sketch.track(fresh)
+    assert e1 is e2
+    assert e2.key is fresh  # refreshed for identity fast paths
+
+
+def test_thread_safety_under_concurrent_feed_and_snapshot():
+    """Concurrent feeders + a snapshotting reader: the structure stays
+    sane (no exceptions, capacity respected, keys unique, counts in a
+    plausible range — lost lock-free bumps are the accepted race)."""
+    sketch = HotKeySketch(capacity=16)
+    per_thread = 5_000
+    errors = []
+
+    def feeder(seed):
+        try:
+            feed(sketch, skewed_stream(seed=seed, n=per_thread))
+        except Exception as e:  # pragma: no cover - failure surface
+            errors.append(e)
+
+    def reader():
+        try:
+            for _ in range(200):
+                snap = sketch.snapshot_dict()
+                assert len(snap["keys"]) <= 16
+        except Exception as e:  # pragma: no cover - failure surface
+            errors.append(e)
+
+    threads = [threading.Thread(target=feeder, args=(s,)) for s in range(4)]
+    threads.append(threading.Thread(target=reader))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    snap = sketch.snapshot()
+    assert len(snap) <= 16
+    keys = [e["key"] for e in snap]
+    assert len(keys) == len(set(keys))
+    # Heavy hitters survive the churn even with racy bumps.
+    assert {"hot-a", "hot-b", "hot-c"} <= set(keys)
+
+
+def test_capacity_must_be_positive():
+    with pytest.raises(ValueError):
+        HotKeySketch(0)
+
+
+# -- metric family ------------------------------------------------------------
+
+
+def test_register_stats_exports_bounded_family_only():
+    from ratelimit_tpu.stats.manager import StatsStore
+
+    store = StatsStore()
+    sketch = HotKeySketch(capacity=8)
+    sketch.register_stats(store)
+    feed(sketch, ["k1"] * 4 + ["k2"])
+    snap = store.snapshot()
+    assert snap["ratelimit.tpu.hotkeys.tracked"] == 2
+    assert snap["ratelimit.tpu.hotkeys.capacity"] == 8
+    assert snap["ratelimit.tpu.hotkeys.observed"] == 5
+    assert snap["ratelimit.tpu.hotkeys.evictions"] == 0
+    assert snap["ratelimit.tpu.hotkeys.top_hits"] == 4
+    assert snap["ratelimit.tpu.hotkeys.min_count"] == 1
+    # BOUNDED: no per-key names may ever leak into the store.
+    assert not [n for n in snap if "k1" in n or "k2" in n]
+
+
+# -- serving-path feed (do_limit_resolved) ------------------------------------
+
+YAML = """
+domain: hk
+descriptors:
+  - key: user
+    rate_limit:
+      unit: hour
+      requests_per_unit: 10
+"""
+
+
+class _Runtime:
+    def __init__(self, files):
+        self._files = files
+
+    def snapshot(self):
+        files = self._files
+
+        class Snap:
+            def keys(self):
+                return sorted(files)
+
+            def get(self, key):
+                return files.get(key, "")
+
+        return Snap()
+
+    def add_update_callback(self, fn):
+        pass
+
+
+def make_service(hotkeys_top_k, clock=None):
+    from ratelimit_tpu.backends.engine import CounterEngine
+    from ratelimit_tpu.backends.tpu_cache import TpuRateLimitCache
+    from ratelimit_tpu.service import RateLimitService
+    from ratelimit_tpu.stats.manager import Manager
+    from ratelimit_tpu.utils.time import PinnedTimeSource
+
+    clock = clock or PinnedTimeSource(1_700_000_000)
+    engine = CounterEngine(num_slots=1 << 10)
+    cache = TpuRateLimitCache(engine, clock, hotkeys_top_k=hotkeys_top_k)
+    mgr = Manager()
+    svc = RateLimitService(_Runtime({"config.hk": YAML}), cache, mgr, clock=clock)
+    return svc, cache, mgr
+
+
+def _req(value, hits=0):
+    from ratelimit_tpu.api import Descriptor, RateLimitRequest
+
+    return RateLimitRequest("hk", [Descriptor.of(("user", value))], hits)
+
+
+def test_serving_feed_counts_stems_and_outcome_shares():
+    svc, cache, _ = make_service(hotkeys_top_k=8)
+    for _ in range(14):  # limit 10: 10 OK (2 of them near), 4 over
+        svc.should_rate_limit(_req("alice"))
+    svc.should_rate_limit(_req("bob"))
+    snap = cache.hotkeys.snapshot()
+    assert [e["key"] for e in snap][:1] == ["hk_user_alice_"]
+    alice = snap[0]
+    assert alice["hits"] == 14 and alice["error"] == 0
+    assert alice["over_limit"] == 4
+    # near threshold = floor(10 * 0.8) = 8: afters 9 and 10 are near.
+    assert alice["near_limit"] == 2
+    assert alice["over_limit_share"] == pytest.approx(4 / 14)
+    bob = {e["key"]: e for e in snap}["hk_user_bob_"]
+    assert bob["hits"] == 1 and bob["over_limit"] == 0
+    assert cache.hotkeys.observed == 15
+
+
+def test_serving_decisions_identical_with_and_without_hotkeys():
+    svc_on, _, _ = make_service(hotkeys_top_k=8)
+    svc_off, cache_off, _ = make_service(hotkeys_top_k=0)
+    assert cache_off.hotkeys is None
+    for i in range(25):
+        value = f"u{i % 3}"
+        a = svc_on.should_rate_limit(_req(value))
+        b = svc_off.should_rate_limit(_req(value))
+        assert a.overall_code == b.overall_code
+        assert [
+            (s.code, s.limit_remaining) for s in a.statuses
+        ] == [(s.code, s.limit_remaining) for s in b.statuses]
+
+
+def test_serving_handle_revives_after_eviction():
+    """A stem evicted from the sketch re-registers on its next
+    request (the dead-handle check), instead of silently vanishing."""
+    svc, cache, _ = make_service(hotkeys_top_k=2)
+    svc.should_rate_limit(_req("a"))
+    svc.should_rate_limit(_req("b"))
+    svc.should_rate_limit(_req("c"))  # evicts the min of {a, b}
+    assert cache.hotkeys.evictions == 1
+    evicted = ({"hk_user_a_", "hk_user_b_"} -
+               {e["key"] for e in cache.hotkeys.snapshot()}).pop()
+    value = evicted.rsplit("_", 2)[1]
+    svc.should_rate_limit(_req(value))
+    assert evicted in {e["key"] for e in cache.hotkeys.snapshot()}
+
+
+def test_hits_addend_feeds_the_sketch():
+    svc, cache, _ = make_service(hotkeys_top_k=4)
+    svc.should_rate_limit(_req("a", hits=5))
+    (e,) = cache.hotkeys.snapshot()
+    assert e["hits"] == 5
+    assert cache.hotkeys.observed == 5
+
+
+# -- /debug/hotkeys endpoint --------------------------------------------------
+
+
+def test_debug_hotkeys_endpoint_json_schema():
+    from ratelimit_tpu.server.http_server import HttpServer, add_debug_routes
+
+    svc, cache, mgr = make_service(hotkeys_top_k=8)
+    for _ in range(3):
+        svc.should_rate_limit(_req("alice"))
+    svc.should_rate_limit(_req("bob"))
+
+    server = HttpServer("127.0.0.1", 0, name="debug-test")
+    add_debug_routes(server, mgr.store, svc)
+    server.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.bound_port}/debug/hotkeys", timeout=10
+        ) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"] == "application/json"
+            body = json.loads(r.read().decode())
+    finally:
+        server.stop()
+    assert set(body) == {
+        "capacity", "tracked", "observed", "evictions", "min_count", "keys",
+    }
+    assert body["capacity"] == 8 and body["tracked"] == 2
+    assert body["observed"] == 4
+    assert [k["key"] for k in body["keys"]][0] == "hk_user_alice_"
+    for k in body["keys"]:
+        assert set(k) == {
+            "key", "hits", "error", "over_limit", "near_limit",
+            "over_limit_share", "near_limit_share",
+        }
+    # Ranked heaviest-first.
+    hits = [k["hits"] for k in body["keys"]]
+    assert hits == sorted(hits, reverse=True)
+
+
+def test_debug_hotkeys_endpoint_404_when_disabled():
+    from ratelimit_tpu.server.http_server import HttpServer, add_debug_routes
+
+    svc, cache, mgr = make_service(hotkeys_top_k=0)
+    server = HttpServer("127.0.0.1", 0, name="debug-test")
+    add_debug_routes(server, mgr.store, svc)
+    server.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.bound_port}/debug/hotkeys",
+                timeout=10,
+            )
+        assert exc.value.code == 404
+    finally:
+        server.stop()

@@ -17,6 +17,7 @@ from ratelimit_tpu.analysis.rules import (
     EnvDisciplineRule,
     JaxHostSyncRule,
     LockDisciplineRule,
+    MetricsDisciplineRule,
     TimingDisciplineRule,
     _make_default_rules,
 )
@@ -75,6 +76,38 @@ def test_timing_rule_fires_on_seeded_violations():
     # stamps, and deadline ADDITION stay quiet).
     assert lines_for(findings, "timing-discipline") == [7, 14, 18]
     assert all(f.rule_id == "timing-discipline" for f in findings)
+
+
+def test_metrics_rule_fires_on_seeded_violations():
+    findings = lint(FIXTURES / "metrics_violation.py")
+    # f-string counter/gauge names, .format(), %-format — and nothing
+    # else (literal names, base + ".suffix" composition, and
+    # interpolation on a non-store receiver stay quiet).
+    assert lines_for(findings, "metrics-discipline") == [6, 7, 8, 9]
+    assert all(f.rule_id == "metrics-discipline" for f in findings)
+
+
+def test_metrics_rule_exempts_the_interning_seam():
+    """stats/manager.py is the sanctioned interning point (per-rule
+    scopes are bounded by the config loader); the same call there is
+    allowed by path."""
+    engine = AnalysisEngine([MetricsDisciplineRule()])
+    src = 'def f(store, key):\n    store.counter(f"scope.{key}.hits")\n'
+    assert engine.check_source("pkg/other.py", src) != []
+    assert engine.check_source("ratelimit_tpu/stats/manager.py", src) == []
+
+
+def test_metrics_rule_requires_storeish_receiver_and_reg_method():
+    engine = AnalysisEngine([MetricsDisciplineRule()])
+    quiet = (
+        "def f(registry, store, k):\n"
+        '    registry.counter(f"a.{k}")\n'  # not a store receiver
+        '    store.lookup(f"a.{k}")\n'  # not a registration method
+        '    store.histogram("a.b_ms")\n'  # literal name
+    )
+    assert engine.check_source("pkg/mod.py", quiet) == []
+    loud = 'def f(self, k):\n    self.stats_store.gauge_fn(f"a.{k}", int)\n'
+    assert [f.line for f in engine.check_source("pkg/mod.py", loud)] == [2]
 
 
 def test_timing_rule_handles_from_time_import_time():
@@ -236,6 +269,7 @@ def test_cli_list_rules():
         "env-discipline",
         "dtype-discipline",
         "timing-discipline",
+        "metrics-discipline",
     ):
         assert rule_id in proc.stdout
 

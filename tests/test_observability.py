@@ -345,6 +345,40 @@ def test_statsd_flush_emits_dropped_counter():
         server.close()
 
 
+def test_statsd_flush_delta_tracks_fn_backed_counters():
+    """counter_fn registrations (resolution cache hits, slot-table
+    evictions, hotkeys tallies — plain ints with no drain cursor)
+    flush to statsd as deltas the exporter tracks itself; gauge_fns
+    flush as absolute gauges like the reference's StatGenerators."""
+    store = StatsStore()
+    tally = {"evictions": 5, "depth": 2}
+    store.counter_fn("ratelimit.tpu.bank0.evictions", lambda: tally["evictions"])
+    store.gauge_fn("ratelimit.tpu.bank0.dispatch_queue", lambda: tally["depth"])
+
+    server = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    server.bind(("127.0.0.1", 0))
+    server.settimeout(5)
+    exporter = StatsdExporter(store, "127.0.0.1", server.getsockname()[1])
+    try:
+        exporter.flush()
+        lines = set(server.recv(65536).decode().split("\n"))
+        assert "ratelimit.tpu.bank0.evictions:5|c" in lines
+        assert "ratelimit.tpu.bank0.dispatch_queue:2|g" in lines
+
+        tally["evictions"] = 9  # +4 since the last flush
+        exporter.flush()
+        lines = set(server.recv(65536).decode().split("\n"))
+        assert "ratelimit.tpu.bank0.evictions:4|c" in lines
+
+        exporter.flush()  # unchanged: counter silent, gauge repeats
+        lines = set(server.recv(65536).decode().split("\n"))
+        assert not [l for l in lines if "evictions" in l]
+        assert "ratelimit.tpu.bank0.dispatch_queue:2|g" in lines
+    finally:
+        exporter.stop()
+        server.close()
+
+
 # -- statsd socket lifecycle (satellite) -------------------------------------
 
 

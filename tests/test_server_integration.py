@@ -60,6 +60,10 @@ def runner(tmp_path_factory):
         runtime_subdirectory="ratelimit",
         local_cache_size_in_bytes=0,
         expiration_jitter_max_seconds=0,
+        # Open the capture endpoints for the introspection test; the
+        # default-closed gate is covered by
+        # test_profiling_capture_endpoints_are_gated.
+        debug_profiling=True,
     )
     # Pinned clock through the Runner seam: window-progression
     # assertions can't straddle a real second/minute rollover
@@ -638,6 +642,101 @@ def test_metrics_endpoint_serves_phase_histograms(runner):
     # Counters and gauges are present too.
     assert "ratelimit_server_ShouldRateLimit_total_requests" in text
     assert "ratelimit_tpu_bank0_live_keys" in text
+    # Device-path telemetry: dispatcher queue gauges + high-water
+    # marks, in-flight launches, slot-table capacity/fill/evictions/
+    # rollovers, batch-shape histograms, and the hot-key family.
+    for family in (
+        "ratelimit_tpu_bank0_dispatch_queue",
+        "ratelimit_tpu_bank0_dispatch_queue_hwm",
+        "ratelimit_tpu_bank0_inflight_launches",
+        "ratelimit_tpu_bank0_inflight_hwm",
+        "ratelimit_tpu_bank0_num_slots",
+        "ratelimit_tpu_bank0_slot_fill_pct",
+        "ratelimit_tpu_hotkeys_tracked",
+    ):
+        assert f"# TYPE {family} gauge" in text, family
+    for family in (
+        "ratelimit_tpu_bank0_evictions",
+        "ratelimit_tpu_bank0_window_rollovers",
+        "ratelimit_tpu_hotkeys_observed",
+        "ratelimit_tpu_hotkeys_evictions",
+    ):
+        assert f"# TYPE {family} counter" in text, family
+    assert "# TYPE ratelimit_tpu_bank0_batch_lanes histogram" in text
+    assert "ratelimit_tpu_bank0_batch_items_bucket" in text
+    # The served request above rolled at least one fresh window slot
+    # and landed in at least one launched batch.
+    rollovers = int(
+        [
+            l for l in text.splitlines()
+            if l.startswith("ratelimit_tpu_bank0_window_rollovers ")
+        ][0].rsplit(" ", 1)[1]
+    )
+    assert rollovers >= 1
+    lanes_count = int(
+        [
+            l for l in text.splitlines()
+            if l.startswith("ratelimit_tpu_bank0_batch_lanes_count")
+        ][0].rsplit(" ", 1)[1]
+    )
+    assert lanes_count >= 1
+
+
+def test_profiling_capture_endpoints_are_gated():
+    """/debug/profile and /debug/xla_trace refuse with 403 unless
+    DEBUG_PROFILING is set; /debug/threadz stays open either way."""
+    from ratelimit_tpu.server.debug_profiling import add_profiling_routes
+    from ratelimit_tpu.server.http_server import HttpServer
+
+    def get(port, path):
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30
+            ) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    closed = HttpServer("127.0.0.1", 0, name="debug-closed")
+    add_profiling_routes(closed)  # default: disabled
+    closed.start()
+    try:
+        assert get(closed.bound_port, "/debug/threadz")[0] == 200
+        code, body = get(closed.bound_port, "/debug/profile?seconds=0.1")
+        assert code == 403 and b"DEBUG_PROFILING" in body
+        assert get(closed.bound_port, "/debug/xla_trace?seconds=0.1")[0] == 403
+    finally:
+        closed.stop()
+
+    opened = HttpServer("127.0.0.1", 0, name="debug-open")
+    add_profiling_routes(opened, profiling_enabled=True)
+    opened.start()
+    try:
+        code, body = get(opened.bound_port, "/debug/profile?seconds=0.2")
+        assert code == 200
+        assert b"statistical cpu profile" in body
+    finally:
+        opened.stop()
+
+
+def test_debug_hotkeys_ranks_served_traffic(runner):
+    """/debug/hotkeys through the real server: skewed traffic ranks
+    the heavy stem first, with exact counts at this cardinality."""
+    for _ in range(5):
+        _grpc_call(runner, _request("basic", [("key1", "hotprobe")]))
+    _grpc_call(runner, _request("basic", [("key1", "coldprobe")]))
+    status, out = _http(
+        runner, "/debug/hotkeys", port=runner.debug_server.bound_port
+    )
+    assert status == 200
+    body = json.loads(out.decode())
+    keys = {k["key"]: k for k in body["keys"]}
+    hot = keys["basic_key1_hotprobe_"]
+    cold = keys["basic_key1_coldprobe_"]
+    assert hot["hits"] >= 5 and cold["hits"] >= 1
+    assert hot["hits"] > cold["hits"]
+    ranked = [k["hits"] for k in body["keys"]]
+    assert ranked == sorted(ranked, reverse=True)
 
 
 def test_unsampled_requests_stay_out_of_the_ring(runner):

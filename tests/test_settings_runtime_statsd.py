@@ -131,3 +131,20 @@ def test_round5_env_knobs_parse(monkeypatch):
     assert s.grpc_server_tls_key == "/k"
     assert s.grpc_server_tls_ca == "/ca"
     assert s.gc_tuning is False
+
+
+def test_observability_env_knobs_parse(monkeypatch):
+    """Hot-key sketch capacity and the profiling-capture gate
+    round-trip through new_settings() (defaults: 128 / off)."""
+    from ratelimit_tpu.settings import new_settings
+
+    for var in ("HOTKEYS_TOP_K", "DEBUG_PROFILING"):
+        monkeypatch.delenv(var, raising=False)
+    s = new_settings()
+    assert s.hotkeys_top_k == 128
+    assert s.debug_profiling is False
+    monkeypatch.setenv("HOTKEYS_TOP_K", "0")
+    monkeypatch.setenv("DEBUG_PROFILING", "true")
+    s = new_settings()
+    assert s.hotkeys_top_k == 0
+    assert s.debug_profiling is True
