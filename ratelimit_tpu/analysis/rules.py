@@ -8,7 +8,8 @@ Each rule targets a bug class that has no runtime guard in this repo
                       mutated both inside and outside lock scopes.
 - env-discipline:     os.environ reads outside settings.py / config/.
 - dtype-discipline:   implicit dtype promotion in kernel scatter calls.
-- timing-discipline:  time.time() in duration arithmetic.
+- timing-discipline:  wall clock (time.time / datetime.now/utcnow)
+                      in duration arithmetic.
 - metrics-discipline: interpolated (unbounded-cardinality) metric
                       names in stats registrations.
 """
@@ -701,32 +702,51 @@ class MetricsDisciplineRule(Rule):
 
 
 class TimingDisciplineRule(Rule):
-    """``time.time()`` in duration arithmetic.
+    """Wall-clock reads in duration arithmetic.
 
     The wall clock is not monotonic: NTP slews/steps and manual sets
     make ``time.time() - t0`` go negative or jump hours — precisely
-    the failure class the per-phase latency histograms and trace spans
-    exist to measure honestly (observability/).  Durations belong to
-    ``time.perf_counter()`` / ``time.monotonic()``; wall clock is for
-    TIMESTAMPS (logging, persistence, cross-process stamps).
+    the failure class the per-phase latency histograms, trace spans
+    and anomaly detectors exist to measure honestly (observability/).
+    Durations belong to ``time.perf_counter()`` / ``time.monotonic()``
+    (or the injectable MonotonicClock seam, utils/time.py); wall clock
+    is for TIMESTAMPS (logging, persistence, cross-process stamps).
 
-    Flags a subtraction where either operand is a direct
-    ``time.time()`` call, or a name bound from ``time.time()`` in the
-    same function (or module) scope.  Additions and comparisons are
+    Flags a subtraction where either operand is a direct wall-clock
+    call — ``time.time()``, ``datetime.now()``, ``datetime.utcnow()``
+    (either import style) — or a name bound from one in the same
+    function (or module) scope.  Additions and comparisons are
     untouched — storing or displaying wall stamps is fine.
     """
 
     id = "timing-discipline"
-    description = "time.time() used in duration arithmetic"
+    description = "wall clock (time.time/datetime.now) in duration arithmetic"
     interests = (ast.BinOp,)
 
     def begin_file(self, ctx: FileContext) -> None:
-        self._wall_callees = {"time.time"}
-        # `from time import time` makes the bare call wall-clock too.
+        self._wall_callees = {
+            "time.time",
+            # `import datetime` style; datetime.now(tz) with an aware
+            # tz still steps under NTP — the tz argument changes the
+            # epoch, not the clock.
+            "datetime.datetime.now",
+            "datetime.datetime.utcnow",
+        }
         for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.ImportFrom) and node.module == "time":
+            if not isinstance(node, ast.ImportFrom):
+                continue
+            # `from time import time` makes the bare call wall-clock.
+            if node.module == "time":
                 if any(a.name == "time" for a in node.names):
                     self._wall_callees.add("time")
+            # `from datetime import datetime [as dt]` makes
+            # `datetime.now()` / `dt.utcnow()` wall-clock too.
+            elif node.module == "datetime":
+                for a in node.names:
+                    if a.name == "datetime":
+                        bound = a.asname or a.name
+                        self._wall_callees.add(bound + ".now")
+                        self._wall_callees.add(bound + ".utcnow")
         # scope node (FunctionDef or the Module) -> names bound from a
         # wall-clock call within it.
         self._wall_names: Dict[Optional[ast.AST], Set[str]] = {}
@@ -788,9 +808,10 @@ class TimingDisciplineRule(Rule):
             self.report(
                 ctx,
                 node,
-                "time.time() in duration arithmetic: the wall clock "
-                "steps under NTP; use time.perf_counter()/monotonic() "
-                "for durations (wall clock is for timestamps)",
+                "wall clock in duration arithmetic: time.time()/"
+                "datetime.now() step under NTP; use time.perf_counter()"
+                "/monotonic() for durations (wall clock is for "
+                "timestamps)",
             )
 
 

@@ -378,6 +378,10 @@ class BatchDispatcher:
         # Intake high-water mark, written only by the collector under
         # the intake cv (one max() per drain swap, not per item).
         self._queue_hwm = 0
+        # Same mark but resettable: the anomaly sampler drains it each
+        # tick (queue_hwm_drain), so a between-scrapes burst is a
+        # per-tick number instead of a forever-latched maximum.
+        self._queue_hwm_tick = 0
         # Batch-shape histograms (stats.Histogram or None), wired by
         # TpuRateLimitCache.register_stats; observed once per launch
         # on the collector thread.  Lanes/items counts, not ms.
@@ -458,6 +462,17 @@ class BatchDispatcher:
         saturated when this pins at the configured depth."""
         return self._inflight_hwm
 
+    def queue_hwm_drain(self) -> int:
+        """Deepest intake drain since the LAST call, reset on read
+        (the queue-saturation detector's per-tick input,
+        observability/detectors.py).  Includes the current intake
+        depth so a still-growing backlog registers even before the
+        collector swaps it."""
+        with self._buf_cv:
+            v = self._queue_hwm_tick
+            self._queue_hwm_tick = 0
+            return max(v, len(self._buf))
+
     def submit(self, item: WorkItem) -> None:
         self._enqueue(item)
 
@@ -515,6 +530,8 @@ class BatchDispatcher:
                 self._buf = []
                 if len(drained) > self._queue_hwm:
                     self._queue_hwm = len(drained)
+                if len(drained) > self._queue_hwm_tick:
+                    self._queue_hwm_tick = len(drained)
 
             cut = None
             try:

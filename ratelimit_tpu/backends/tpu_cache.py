@@ -136,6 +136,13 @@ class TpuRateLimitCache:
         self._near_ratio = float(
             getattr(lanes[0].model, "near_ratio", 0.8)
         )
+        # Flight recorder (observability/flight.py), attached by the
+        # runner when FLIGHT_RECORDER_SIZE > 0: the resolution fast
+        # path deposits the decisive descriptor's (stem hash, bank)
+        # into its thread-local note, and the transport layer stamps
+        # the ring record after serialize.  None = disabled (the
+        # per-request cost is one attribute load + branch).
+        self.flight = None
         self.expiration_jitter_max_seconds = int(expiration_jitter_max_seconds)
         self.jitter_rand = jitter_rand or random.Random()
         # Liveness backstop for dispatcher waits; generous because the
@@ -317,6 +324,11 @@ class TpuRateLimitCache:
         hk = self.hotkeys
         hot: Optional[list] = [None] * n if hk is not None else None
         hk_observed = 0  # batched into hk.observed after the loop
+        # Flight-recorder note: the FIRST limited descriptor is the
+        # request's decisive identity in the ring (stem hash + bank).
+        # One branch per descriptor until noted, then free.
+        fl = self.flight
+        fl_pending = fl is not None
         # Inlined resolve() hit path: one dict probe + generation
         # check per descriptor, with the hit tally batched into one
         # attribute add per request.  Misses (and their counting) go
@@ -353,6 +365,13 @@ class TpuRateLimitCache:
                 is_unlimited[i] = True
                 continue  # limits[i] stays None (service contract)
             limits[i] = rule
+            if fl_pending:
+                fl_pending = False
+                fl.note(
+                    rd.stem_hash,
+                    n_lanes if ps_bank is not None and rd.per_second
+                    else rd.lane,
+                )
             if hk is not None:
                 e = rd.hot
                 if e is None or e.key is None:
@@ -717,6 +736,15 @@ class TpuRateLimitCache:
 
         for d in self._dispatchers.values():
             d.on_state = make_on_state(id(d))
+
+    def queue_hwm_drain(self) -> int:
+        """Deepest per-tick intake drain across every bank's
+        dispatcher, reset on read — the queue-saturation detector's
+        input (observability/detectors.py)."""
+        return max(
+            (d.queue_hwm_drain() for d in self._dispatchers.values()),
+            default=0,
+        )
 
     def flush(self) -> None:
         """Drain the dispatcher queues (deterministic test hook; the

@@ -104,6 +104,7 @@ class ResolvedDescriptor:
         "per_second",
         "stem",
         "stem_bytes",
+        "stem_hash",
         "n_lanes",
         "lane",
         "unit",
@@ -119,8 +120,12 @@ class ResolvedDescriptor:
         self.unlimited = rule is not None and rule.unlimited
         self.stem = stem
         self.stem_bytes = stem.encode("utf-8")
+        # One crc32 per resolution (cold path): the lane route below
+        # and the flight recorder's key-stem hash share it, so ring
+        # records and lane hashing agree by construction.
+        self.stem_hash = crc32(self.stem_bytes)
         self.n_lanes = n_lanes
-        self.lane = crc32(self.stem_bytes) % n_lanes if n_lanes > 1 else 0
+        self.lane = self.stem_hash % n_lanes if n_lanes > 1 else 0
         self._lane_dtype = lane_dtype
         self._win: Optional[WindowState] = None
         # Hot-key sketch handle (observability/hotkeys.py), pinned by
@@ -142,7 +147,7 @@ class ResolvedDescriptor:
         for the new modulus.  The amnesia envelope is the same as a
         restart with a changed TPU_NUM_LANES — old windows' counters
         age out in the old lane while the key counts afresh."""
-        self.lane = crc32(self.stem_bytes) % n_lanes if n_lanes > 1 else 0
+        self.lane = self.stem_hash % n_lanes if n_lanes > 1 else 0
         self.n_lanes = n_lanes
 
     def window_state(self, now: int) -> WindowState:

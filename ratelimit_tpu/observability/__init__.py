@@ -6,9 +6,26 @@
 - ``tracez``:     ``GET /debug/tracez`` rendering.
 - ``hotkeys``:    Space-Saving top-K sketch of the hottest descriptor
                   stems (``GET /debug/hotkeys``).
+- ``flight``:     lock-free per-request decision ring (the black box
+                  the detectors snapshot into incident reports).
+- ``detectors``:  EWMA-baselined anomaly triggers + incident capture
+                  (``GET /debug/incidents``).
+- ``slo``:        per-domain availability/latency SLIs and error-
+                  budget burn rates (``GET /debug/slo``).
 """
 
+from .detectors import (
+    AnomalyDetectors,
+    Detector,
+    ErrorRateDetector,
+    Ewma,
+    LatencySpikeDetector,
+    OverLimitSurgeDetector,
+    QueueSaturationDetector,
+)
+from .flight import FLIGHT_DTYPE, FlightRecorder, make_flight_recorder
 from .hotkeys import HotKeyEntry, HotKeySketch
+from .slo import SloEngine
 from .trace import (
     NOOP_SPAN,
     TRACEPARENT_HEADER,
@@ -26,15 +43,26 @@ from .trace import (
 __all__ = [
     "NOOP_SPAN",
     "TRACEPARENT_HEADER",
+    "AnomalyDetectors",
+    "Detector",
+    "ErrorRateDetector",
+    "Ewma",
+    "FLIGHT_DTYPE",
     "FinishedTrace",
+    "FlightRecorder",
     "HotKeyEntry",
     "HotKeySketch",
     "JsonlExporter",
+    "LatencySpikeDetector",
+    "OverLimitSurgeDetector",
+    "QueueSaturationDetector",
+    "SloEngine",
     "Span",
     "SpanContext",
     "TRACER",
     "Tracer",
     "format_traceparent",
     "log_exporter",
+    "make_flight_recorder",
     "parse_traceparent",
 ]

@@ -50,6 +50,13 @@ def render(store) -> str:
         lines.append(f"# TYPE {n} gauge")
         lines.append(f"{n} {value}")
 
+    # Float gauges (SLO burn rates / SLI ratios): fractional values the
+    # integer gauge registry would truncate (stats/manager.py).
+    for name, value in sorted(store.float_gauges().items()):
+        n = metric_name(name)
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {_fmt(round(value, 6))}")
+
     for name in sorted(store.histogram_names()):
         h = store.histogram(name)
         bounds, counts, total_sum, total_count = h.snapshot()

@@ -176,6 +176,9 @@ class Runner:
         self.health = None
         self.checkpointer = None
         self._trace_jsonl = None
+        self.flight = None
+        self.slo = None
+        self.detectors = None
 
     # -- lifecycle (runner.go:76-143) -----------------------------------
 
@@ -244,6 +247,34 @@ class Runner:
         self.cache = create_limiter(s, self.stats_manager, local_cache, time_source)
         if hasattr(self.cache, "register_stats"):
             self.cache.register_stats(self.stats_manager.store)
+
+        # Decision flight recorder + per-domain SLO engine
+        # (observability/{flight,slo}.py; docs/OBSERVABILITY.md).  The
+        # recorder attaches to the backend's note seam so ring records
+        # carry the decisive descriptor's stem hash + bank; both stamp
+        # on the RPC thread next to the per-phase histogram sink.
+        from .observability import (
+            AnomalyDetectors,
+            ErrorRateDetector,
+            LatencySpikeDetector,
+            OverLimitSurgeDetector,
+            QueueSaturationDetector,
+            SloEngine,
+            make_flight_recorder,
+        )
+
+        store = self.stats_manager.store
+        self.flight = make_flight_recorder(s.flight_recorder_size)
+        if self.flight is not None:
+            self.flight.register_stats(store)
+            if hasattr(self.cache, "flight"):
+                self.cache.flight = self.flight
+        self.slo = SloEngine(
+            self.stats_manager,
+            target=s.slo_target,
+            window_s=s.slo_window_s,
+            latency_threshold_ms=s.slo_latency_ms,
+        )
         if s.tpu_warmup and hasattr(self.cache, "warmup"):
             logger.warning("warming up kernel shapes (TPU_WARMUP=true)...")
             self.cache.warmup()
@@ -280,7 +311,50 @@ class Runner:
             # pick them up.
             settings_reloader=new_settings,
         )
+        # SLO domains follow the config: attach the engine, then adopt
+        # the already-loaded snapshot (construction above reloaded
+        # before the attribute existed).
+        self.service.slo = self.slo
+        config = self.service.get_current_config()
+        if config is not None:
+            self.slo.set_domains(config.domains.keys())
         self.runtime.start()
+
+        # Anomaly detectors + incident capture (detectors.py).  Always
+        # constructed — /debug/incidents and the deterministic tick()
+        # seam work even with the sampler off — but the thread only
+        # runs when ANOMALY_INTERVAL_S > 0.
+        self.detectors = AnomalyDetectors(
+            store,
+            [
+                LatencySpikeDetector(
+                    store.histogram(
+                        "ratelimit_server.ShouldRateLimit.response_ms"
+                    ),
+                    factor=s.anomaly_spike_factor,
+                    min_samples=s.anomaly_min_samples,
+                ),
+                OverLimitSurgeDetector(
+                    self.slo,
+                    factor=s.anomaly_spike_factor,
+                    min_requests=s.anomaly_min_samples,
+                ),
+                QueueSaturationDetector(
+                    getattr(self.cache, "queue_hwm_drain", lambda: 0),
+                    threshold=s.anomaly_queue_depth,
+                ),
+                ErrorRateDetector(store),
+            ],
+            flight=self.flight,
+            tracer=TRACER,
+            slo=self.slo,
+            incident_dir=s.incident_dir,
+            incident_max=s.incident_max,
+            interval_s=s.anomaly_interval_s,
+            cooldown_s=s.anomaly_cooldown_s,
+        )
+        self.detectors.register_stats(store)
+        self.detectors.start()
 
         self.health = HealthChecker()
         if hasattr(self.cache, "bind_health"):
@@ -317,11 +391,15 @@ class Runner:
             max_workers=s.grpc_max_workers,
             credentials=credentials,
             auth_token=s.grpc_auth_token,
+            flight=self.flight,
+            slo=self.slo,
         )
         self.grpc_server.start()
 
         self.http_server = HttpServer(s.host, s.port, name="api")
-        add_json_handler(self.http_server, self.service)
+        add_json_handler(
+            self.http_server, self.service, flight=self.flight, slo=self.slo
+        )
         add_healthcheck(self.http_server, self.health)
         self.http_server.start()
 
@@ -331,6 +409,8 @@ class Runner:
             self.stats_manager.store,
             self.service,
             profiling_enabled=s.debug_profiling,
+            detectors=self.detectors,
+            slo=self.slo,
         )
         add_healthcheck(self.debug_server, self.health)
         self.debug_server.start()
@@ -388,6 +468,8 @@ class Runner:
                 srv.stop()
         if self.runtime is not None:
             self.runtime.stop()
+        if self.detectors is not None:
+            self.detectors.stop()
         if self.checkpointer is not None:
             self.checkpointer.stop(final_checkpoint=True)
         if self.statsd is not None:

@@ -200,22 +200,51 @@ def add_profiling_routes(
         finally:
             trace_lock.release()
 
-    def pprof_index(h) -> None:
-        h._reply(
-            200,
-            b"live introspection endpoints (Go pprof analogs):\n"
-            b"  /debug/threadz              all-thread stack dump\n"
-            b"  /debug/profile?seconds=N    statistical CPU profile"
-            b" (DEBUG_PROFILING=1)\n"
-            b"  /debug/xla_trace?seconds=N  jax.profiler trace capture"
-            b" (DEBUG_PROFILING=1)\n"
-            b"  /debug/tracez               slowest + recent request traces\n"
-            b"  /debug/hotkeys              top-K hottest descriptor stems\n"
-            b"  /stats                      counters/gauges/timers/histograms\n"
-            b"  /metrics                    Prometheus text exposition\n",
-        )
+    def debug_index(h) -> None:
+        h._reply(200, render_debug_index(server).encode())
 
     server.add_route("GET", "/debug/threadz", threadz)
     server.add_route("GET", "/debug/profile", profile)
     server.add_route("GET", "/debug/xla_trace", xla_trace)
-    server.add_route("GET", "/debug/pprof/", pprof_index)
+    server.add_route("GET", "/debug/", debug_index)
+    # Historical alias (the Go pprof index path).
+    server.add_route("GET", "/debug/pprof/", debug_index)
+
+
+# One-line blurbs for the index page.  Endpoints registered WITHOUT a
+# blurb still render (the index enumerates the live router, so it can
+# never silently omit a route) — they just carry no description, and
+# the index test flags them so the blurb gets written.
+ENDPOINT_BLURBS = {
+    "/stats": "counters/gauges/timers/histograms (plain text)",
+    "/stats.json": "the same stat tree as JSON",
+    "/metrics": "Prometheus text exposition (scrape target)",
+    "/rlconfig": "current rate limit config dump",
+    "/healthcheck": "liveness (200 OK / 500 NOT_HEALTHY)",
+    "/debug/": "this index",
+    "/debug/pprof/": "this index (Go pprof path alias)",
+    "/debug/tracez": "slowest + most recent request traces",
+    "/debug/hotkeys": "top-K hottest descriptor stems (JSON)",
+    "/debug/incidents": "captured anomaly incident reports (JSON)",
+    "/debug/slo": "per-domain SLI / error-budget burn summary (JSON)",
+    "/debug/threadz": "all-thread stack dump",
+    "/debug/profile": (
+        "statistical CPU profile ?seconds=N (DEBUG_PROFILING=1)"
+    ),
+    "/debug/xla_trace": (
+        "jax.profiler trace capture ?seconds=N (DEBUG_PROFILING=1)"
+    ),
+}
+
+
+def render_debug_index(server) -> str:
+    """The ``GET /debug/`` page, generated from the LIVE router: every
+    registered GET route appears, so the index cannot drift from the
+    handlers (tested in tests/test_detectors_slo.py)."""
+    paths = sorted(
+        path for method, path in server.router.routes if method == "GET"
+    )
+    lines = ["debug endpoints on this listener:"]
+    for path in paths:
+        lines.append(f"  {path:<22} {ENDPOINT_BLURBS.get(path, '')}".rstrip())
+    return "\n".join(lines) + "\n"

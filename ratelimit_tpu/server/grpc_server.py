@@ -89,7 +89,12 @@ def set_stage_sink(fn) -> None:
     _stage_sink[0] = fn
 
 
-def _ratelimit_handler(service, reporter: Optional[ServerReporter]):
+def _ratelimit_handler(
+    service,
+    reporter: Optional[ServerReporter],
+    flight=None,
+    slo=None,
+):
     serialize = rls_pb2.RateLimitResponse.SerializeToString
     from ..api import Code as _Code
 
@@ -118,6 +123,10 @@ def _ratelimit_handler(service, reporter: Optional[ServerReporter]):
                     # grpc-go turns a plain returned error into UNKNOWN;
                     # mirror that mapping (service/ratelimit.go:239-265).
                     root.set_status("error", str(e))
+                    if slo is not None:
+                        # Availability SLI: a failed decision is a bad
+                        # event for its domain (observability/slo.py).
+                        slo.observe_error(request.domain)
                     context.abort(grpc.StatusCode.UNKNOWN, str(e))
                 t_serviced = time.perf_counter()
                 # Serialize HERE on the handler thread (the method is
@@ -141,6 +150,23 @@ def _ratelimit_handler(service, reporter: Optional[ServerReporter]):
                     reporter.observe_phases(
                         start, t_decoded, t_serviced, t_serialized
                     )
+                # Decision flight recorder + per-domain SLO rollups,
+                # stamped HERE next to the per-phase histogram sink:
+                # everything is already on hand (domain, code, total
+                # latency; the backend noted stem/bank thread-locally)
+                # so the combined cost stays ~1us — see
+                # benchmarks/results/flight_overhead.json.
+                total_ms = (t_serialized - start) * 1e3
+                over = response.overall_code == _Code.OVER_LIMIT
+                if flight is not None:
+                    flight.record(
+                        request.domain,
+                        int(response.overall_code),
+                        request.hits_addend,
+                        total_ms,
+                    )
+                if slo is not None:
+                    slo.observe(request.domain, over, total_ms)
                 return payload
         finally:
             if reporter is not None:
@@ -279,6 +305,8 @@ def create_grpc_server(
     max_workers: int = 32,
     credentials: Optional[grpc.ServerCredentials] = None,
     auth_token: str = "",
+    flight=None,
+    slo=None,
 ) -> grpc.Server:
     """Build (not start) the server; port 0 picks a free port.  The
     bound port is stored on the returned server as ``bound_port``.
@@ -304,7 +332,10 @@ def create_grpc_server(
         ),
     )
     server.add_generic_rpc_handlers(
-        (_ratelimit_handler(service, reporter), _health_handler(health))
+        (
+            _ratelimit_handler(service, reporter, flight=flight, slo=slo),
+            _health_handler(health),
+        )
     )
     addr = f"{host}:{port}"
     if credentials is not None:

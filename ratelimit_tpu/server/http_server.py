@@ -16,7 +16,11 @@ Debug server routes (server_impl.go:238-269, runner.go:117-124):
 - GET /debug/hotkeys    Space-Saving top-K of the hottest descriptor
                         stems (JSON; estimated hits, error bound,
                         over/near-limit share)
-- GET /debug/pprof/     index of the live-introspection endpoints
+- GET /debug/incidents  captured anomaly incident reports (JSON;
+                        flight-ring snapshot + slowest traces + stats)
+- GET /debug/slo        per-domain SLI / error-budget burn summary
+- GET /debug/           index of every registered debug endpoint
+- GET /debug/pprof/     alias of the index
 - GET /debug/threadz    all-thread stack dump
 - GET /debug/profile    statistical all-thread CPU profile   (gated)
 - GET /debug/xla_trace  jax.profiler trace capture            (gated)
@@ -138,14 +142,21 @@ class HttpServer:
             self._thread = None
 
 
-def add_json_handler(server: HttpServer, service) -> None:
+def add_json_handler(server: HttpServer, service, flight=None, slo=None) -> None:
     """POST /json bridge (reference NewJsonHandler,
     server_impl.go:71-109).  Participates in tracing like the gRPC
     handler: an inbound ``traceparent`` header adopts the caller's
     trace, and a recording request echoes its own traceparent back as
-    a response header so the client can find it in /debug/tracez."""
+    a response header so the client can find it in /debug/tracez.
+    Decisions served here stamp the flight recorder and the per-domain
+    SLO rollups exactly like the gRPC handler — both transports are
+    user-facing, so both count."""
+    import time as _time
+
+    from ..api import Code as _Code
 
     def handle(h) -> None:
+        t_start = _time.perf_counter()
         root = TRACER.start_span(
             "http.json", h.headers.get(TRACEPARENT_HEADER)
         )
@@ -172,6 +183,8 @@ def add_json_handler(server: HttpServer, service) -> None:
                 except (ServiceError, CacheError) as e:
                     root.set_status("error", str(e))
                     status, out = 500, f"{e}\n".encode()
+                    if slo is not None:
+                        slo.observe_error(request.domain)
                 else:
                     with TRACER.span("serialize"):
                         response_pb = response_to_pb(response)
@@ -189,6 +202,17 @@ def add_json_handler(server: HttpServer, service) -> None:
                         root.set_status("over_limit")
                     else:
                         status = 500
+                    total_ms = (_time.perf_counter() - t_start) * 1e3
+                    over = response.overall_code == _Code.OVER_LIMIT
+                    if flight is not None:
+                        flight.record(
+                            request.domain,
+                            int(response.overall_code),
+                            request.hits_addend,
+                            total_ms,
+                        )
+                    if slo is not None:
+                        slo.observe(request.domain, over, total_ms)
         headers = (
             [(TRACEPARENT_HEADER, root.traceparent())]
             if root.recording
@@ -210,16 +234,25 @@ def add_healthcheck(server: HttpServer, health: HealthChecker) -> None:
 
 
 def add_debug_routes(
-    server: HttpServer, store, service=None, profiling_enabled: bool = False
+    server: HttpServer,
+    store,
+    service=None,
+    profiling_enabled: bool = False,
+    detectors=None,
+    slo=None,
 ) -> None:
     """/stats, /rlconfig, /metrics, /debug/* (server_impl.go:254-261,
     runner.go:117-124).  ``profiling_enabled`` (the DEBUG_PROFILING
-    setting) opens the capture endpoints in debug_profiling.py."""
+    setting) opens the capture endpoints in debug_profiling.py;
+    ``detectors``/``slo`` (observability/) open /debug/incidents and
+    /debug/slo."""
 
     def stats(h) -> None:
         lines = []
         for name, value in sorted(store.snapshot().items()):
             lines.append(f"{name}: {value}")
+        for name, value in sorted(store.float_gauges().items()):
+            lines.append(f"{name}: {value:.6g}")
         for name, summary in sorted(store.timers().items()):
             lines.append(
                 f"{name}: count={summary['count']} "
@@ -283,6 +316,43 @@ def add_debug_routes(
     server.add_route("GET", "/metrics", metrics)
     server.add_route("GET", "/debug/tracez", tracez)
     server.add_route("GET", "/debug/hotkeys", hotkeys)
+
+    def incidents(h) -> None:
+        # Incident zPage: the bounded in-memory ring of captured
+        # anomaly reports, newest first (observability/detectors.py).
+        # The on-disk mirror (INCIDENT_DIR) holds the same JSON.
+        if detectors is None:
+            h._reply(
+                404,
+                b"anomaly detectors disabled (ANOMALY_INTERVAL_S=0 "
+                b"and no detectors wired)\n",
+            )
+            return
+        body = {
+            "incident_dir": detectors.incident_dir,
+            "captured_total": detectors.captured,
+            "retained": len(detectors.incidents()),
+            "incidents": detectors.incidents(),
+        }
+        h._reply(
+            200,
+            json.dumps(body, default=str).encode(),
+            content_type="application/json",
+        )
+
+    def slo_summary(h) -> None:
+        # Per-domain SLI/burn-rate summary (observability/slo.py).
+        if slo is None:
+            h._reply(404, b"slo engine disabled\n")
+            return
+        h._reply(
+            200,
+            json.dumps(slo.summary()).encode(),
+            content_type="application/json",
+        )
+
+    server.add_route("GET", "/debug/incidents", incidents)
+    server.add_route("GET", "/debug/slo", slo_summary)
 
     if service is not None:
 

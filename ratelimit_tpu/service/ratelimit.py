@@ -44,6 +44,11 @@ class CacheError(Exception):
 
 
 class RateLimitService:
+    # Per-domain SLO engine (observability/slo.py), attached by the
+    # runner after construction; reload_config feeds it the configured
+    # domain set so per-domain metric families stay bounded by config.
+    slo = None
+
     def __init__(
         self,
         runtime,
@@ -112,6 +117,10 @@ class RateLimitService:
             logger.error("error loading new configuration from runtime: %s", e)
             return
         self.stats.config_load_success.inc()
+        if self.slo is not None:
+            # Adopt the new configured domain set BEFORE the swap so a
+            # request racing the reload finds its domain interned.
+            self.slo.set_domains(new_config.domains.keys())
         with self._config_lock:
             self._config = new_config
             if self._settings_reloader is not None:

@@ -224,6 +224,41 @@ class Settings:
     # which is an operator action, not a default-open surface.
     debug_profiling: bool = False
 
+    # Decision flight recorder (observability/flight.py): slots in the
+    # lock-free per-request decision ring the anomaly detectors
+    # snapshot into incident reports.  0 disables recording entirely
+    # (the serving path pays one attribute load + branch).
+    flight_recorder_size: int = 4096
+    # Anomaly detectors (observability/detectors.py): sampler cadence;
+    # 0 disables the sampler thread (and incident capture).  The
+    # shared knobs below tune the EWMA-baselined triggers — see
+    # docs/INCIDENT_RUNBOOK.md for what to turn when a detector is too
+    # chatty or too quiet.
+    anomaly_interval_s: float = 5.0
+    # Spike multiplier over the EWMA baseline (latency p99 and
+    # per-domain OVER_LIMIT-rate triggers).
+    anomaly_spike_factor: float = 4.0
+    # Minimum events per tick before a rate/quantile trigger may trip
+    # (starves one-request noise).
+    anomaly_min_samples: int = 20
+    # Absolute dispatcher intake depth (per tick high-water) that
+    # counts as saturation.
+    anomaly_queue_depth: int = 512
+    # Seconds between captures of the SAME detector (one incident per
+    # episode, not per tick).
+    anomaly_cooldown_s: float = 60.0
+    # Incident reports: on-disk mirror directory ("" keeps them
+    # in-memory only, served at /debug/incidents) and the retention
+    # cap applied to both the memory ring and the directory.
+    incident_dir: str = ""
+    incident_max: int = 16
+    # Per-domain SLO engine (observability/slo.py): availability /
+    # latency SLI target, rolling window, and the latency threshold a
+    # request must beat to count as "fast".
+    slo_target: float = 0.999
+    slo_window_s: float = 3600.0
+    slo_latency_ms: float = 50.0
+
     # Request tracing (observability/trace.py; docs/OBSERVABILITY.md).
     # Head-sampling probability for traces with no inbound traceparent
     # (an inbound sampled flag always wins); 0.0 = only errors and
@@ -308,6 +343,17 @@ def new_settings() -> Settings:
         tpu_compile_cache_dir=_env_str("TPU_COMPILE_CACHE_DIR", ""),
         hotkeys_top_k=_env_int("HOTKEYS_TOP_K", 128),
         debug_profiling=_env_bool("DEBUG_PROFILING", False),
+        flight_recorder_size=_env_int("FLIGHT_RECORDER_SIZE", 4096),
+        anomaly_interval_s=_env_float("ANOMALY_INTERVAL_S", 5.0),
+        anomaly_spike_factor=_env_float("ANOMALY_SPIKE_FACTOR", 4.0),
+        anomaly_min_samples=_env_int("ANOMALY_MIN_SAMPLES", 20),
+        anomaly_queue_depth=_env_int("ANOMALY_QUEUE_DEPTH", 512),
+        anomaly_cooldown_s=_env_float("ANOMALY_COOLDOWN_S", 60.0),
+        incident_dir=_env_str("INCIDENT_DIR", ""),
+        incident_max=_env_int("INCIDENT_MAX", 16),
+        slo_target=_env_float("SLO_TARGET", 0.999),
+        slo_window_s=_env_float("SLO_WINDOW_S", 3600.0),
+        slo_latency_ms=_env_float("SLO_LATENCY_MS", 50.0),
         trace_sample_rate=_env_float("TRACE_SAMPLE_RATE", 0.0),
         trace_sample_errors=_env_bool("TRACE_SAMPLE_ERRORS", True),
         trace_ring_size=_env_int("TRACE_RING_SIZE", 256),

@@ -234,6 +234,7 @@ class StatsStore:
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._gauge_fns: Dict[str, "callable"] = {}
+        self._float_gauge_fns: Dict[str, "callable"] = {}
         self._counter_fns: Dict[str, "callable"] = {}
         self._timers: Dict[str, Timer] = {}
         self._histograms: Dict[str, Histogram] = {}
@@ -324,6 +325,21 @@ class StatsStore:
         with self._lock:
             self._gauge_fns[name] = fn
 
+    def float_gauge_fn(self, name: str, fn) -> None:
+        """Register a live FLOAT gauge (SLO burn rates, SLI ratios —
+        values whose useful range is fractional, where the int gauges
+        above would truncate 1.4x burn to 1).  Exported on /metrics as
+        a gauge and flushed to statsd as ``|g``; kept in a separate
+        registry so the integer contract of gauges()/snapshot() — and
+        every golden test over it — is untouched."""
+        with self._lock:
+            self._float_gauge_fns[name] = fn
+
+    def float_gauges(self) -> Dict[str, float]:
+        with self._lock:
+            fns = list(self._float_gauge_fns.items())
+        return {name: float(fn()) for name, fn in fns}
+
     def gauges(self) -> Dict[str, int]:
         with self._lock:
             out = {name: g.value() for name, g in self._gauges.items()}
@@ -393,6 +409,34 @@ class ServiceStats:
         self.global_shadow_mode = store.counter(scope + ".global_shadow_mode")
 
 
+class SloStats:
+    """Per-domain SLO rollup tallies (observability/slo.py).
+
+    Plain ints bumped lock-free on the RPC thread (the same accepted
+    stats-only race as the resolution-cache tallies); exported through
+    the store's counter_fn seam so the statsd exporter delta-tracks
+    them and /metrics renders cumulative counters.  ``slow`` counts
+    requests over the latency SLO threshold; ``errors`` counts
+    service/backend failures (the availability SLI's bad events —
+    OVER_LIMIT is correct behavior for a rate limiter, so it is
+    tallied separately, not as unavailability)."""
+
+    __slots__ = ("domain", "requests", "over_limit", "errors", "slow")
+
+    def __init__(self, domain: str):
+        self.domain = domain
+        self.requests = 0
+        self.over_limit = 0
+        self.errors = 0
+        self.slow = 0
+
+
+# Per-domain SLO families are bounded by the CONFIGURED domain set
+# (SloEngine.set_domains folds unconfigured traffic into "_other");
+# this cap is the backstop against a pathological config.
+MAX_SLO_DOMAINS = 64
+
+
 class Manager:
     """Owner of the stat scopes (reference stats.Manager seam)."""
 
@@ -405,7 +449,9 @@ class Manager:
             root += "".join(f".__{k}={v}" for k, v in sorted(extra_tags.items()))
         self.service_scope = root + ".service"
         self.rl_scope = self.service_scope + ".rate_limit"
+        self.slo_scope = root + ".tpu.slo"
         self._rule_stats: Dict[str, RateLimitStats] = {}
+        self._slo_stats: Dict[str, SloStats] = {}
         self._lock = threading.Lock()
 
     def rate_limit_stats(self, key: str) -> RateLimitStats:
@@ -422,3 +468,29 @@ class Manager:
 
     def service_stats(self) -> ServiceStats:
         return ServiceStats(self.service_scope, self.store)
+
+    def slo_stats(self, domain: str) -> SloStats:
+        """Per-domain SLO rollups; equivalent calls return the same
+        tallies (the rate_limit_stats interning pattern applied to
+        domains).  This method is the cardinality seam: metric names
+        are minted HERE, once per interned domain, never per request
+        — past MAX_SLO_DOMAINS everything folds into "_other"."""
+        with self._lock:
+            s = self._slo_stats.get(domain)
+            if s is None:
+                if (
+                    len(self._slo_stats) >= MAX_SLO_DOMAINS
+                    and domain != "_other"
+                ):
+                    domain = "_other"
+                    s = self._slo_stats.get(domain)
+                    if s is not None:
+                        return s
+                s = self._slo_stats[domain] = SloStats(domain)
+                base = f"{self.slo_scope}.{domain}"
+                store = self.store
+                store.counter_fn(base + ".requests", lambda: s.requests)
+                store.counter_fn(base + ".over_limit", lambda: s.over_limit)
+                store.counter_fn(base + ".errors", lambda: s.errors)
+                store.counter_fn(base + ".slow", lambda: s.slow)
+            return s

@@ -130,6 +130,8 @@ class StatsdExporter:
                 lines.append(f"{name}:{delta}|c")
         for name, value in self.store.gauges().items():
             lines.append(f"{name}:{value}|g")
+        for name, value in self.store.float_gauges().items():
+            lines.append(f"{name}:{value:.6g}|g")
         for t in timers:
             for ms in t.drain_samples():
                 lines.append(f"{t.name}:{ms:.3f}|ms")

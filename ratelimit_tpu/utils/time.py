@@ -90,6 +90,56 @@ class PinnedTimeSource(TimeSource):
         return self.now
 
 
+class MonotonicClock:
+    """Monotonic-clock seam for duration/interval math (detectors,
+    EWMA baselines, SLO windows, the flight recorder's timestamps).
+
+    The wall-clock :class:`TimeSource` seam above pins *window* math;
+    this one pins *elapsed-time* math, so anomaly detectors and SLO
+    burn windows are unit-testable with synthetic time — tests drive
+    :class:`FakeMonotonicClock.advance` instead of sleeping (the same
+    no-sleeps discipline the dispatcher tests follow).  Durations
+    must come from here or ``time.monotonic``/``perf_counter`` —
+    never the wall clock (tpu-lint ``timing-discipline``)."""
+
+    def now(self) -> float:
+        """Seconds on a monotonic clock (arbitrary epoch)."""
+        raise NotImplementedError
+
+    def now_ns(self) -> int:
+        """Nanoseconds on the same clock (flight-record stamps)."""
+        return int(self.now() * 1e9)
+
+
+class RealMonotonicClock(MonotonicClock):
+    def now(self) -> float:
+        return time.monotonic()
+
+    def now_ns(self) -> int:
+        return time.monotonic_ns()
+
+
+#: Process-wide default; inject a FakeMonotonicClock in tests.
+REAL_MONOTONIC = RealMonotonicClock()
+
+
+class FakeMonotonicClock(MonotonicClock):
+    """A settable monotonic clock (PinnedTimeSource's twin for
+    elapsed-time seams): tests advance it explicitly, so detector
+    cooldowns, EWMA cadences and SLO windows progress deterministically
+    with no real sleeping."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+
+    def advance(self, seconds: float) -> float:
+        self._now += float(seconds)
+        return self._now
+
+    def now(self) -> float:
+        return self._now
+
+
 class MonotonicBatchClock(TimeSource):
     """A time source snapshotted once per batch.
 

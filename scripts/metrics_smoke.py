@@ -14,7 +14,14 @@ full gRPC stack, then asserts:
   the server still serves afterwards — a wedged capture lock or a
   blocked listener would fail here, not in production;
 - GET /debug/tracez shows the request's trace (the inbound traceparent
-  id) with the kernel-phase span.
+  id) with the kernel-phase span;
+- the synthetic-anomaly scenario: injected latency + a forced
+  OVER_LIMIT burst trip the EWMA detectors on a deterministic
+  detectors.tick(), a bounded incident JSON (with a non-empty flight-
+  ring snapshot) lands in INCIDENT_DIR and round-trips through
+  GET /debug/incidents, the per-domain ratelimit.tpu.slo.* burn-rate
+  family shows on /metrics, and GET /debug/slo + the generated
+  GET /debug/ index are well-formed.
 
 Exit 0 on success; any assertion prints context and exits 1.
 """
@@ -38,6 +45,10 @@ descriptors:
     rate_limit:
       unit: minute
       requests_per_unit: 100
+  - key: burst
+    rate_limit:
+      unit: minute
+      requests_per_unit: 2
 """
 
 
@@ -75,6 +86,15 @@ def main() -> int:
                 expiration_jitter_max_seconds=0,
                 hotkeys_top_k=8,
                 debug_profiling=True,
+                flight_recorder_size=256,
+                incident_dir=str(Path(tmp) / "incidents"),
+                incident_max=4,
+                # Sampler thread on (liveness) but slow; the scenario
+                # below drives deterministic ticks itself.
+                anomaly_interval_s=60.0,
+                anomaly_min_samples=5,
+                anomaly_cooldown_s=0.0,
+                slo_latency_ms=50.0,
             )
         )
         runner.start()
@@ -194,6 +214,93 @@ def main() -> int:
             assert trace_id in tracez, tracez
             for span in ("decode", "service.should_rate_limit", "kernel.step"):
                 assert span in tracez, (span, tracez)
+
+            # --- synthetic-anomaly scenario ---------------------------
+            # Deterministic detector ticks: tick 1 primes the delta
+            # cursors, normal traffic then tick 2 seeds the EWMA
+            # baselines, then injected latency (straight into the
+            # response histogram the latency detector watches) plus a
+            # forced OVER_LIMIT burst on the tiny `burst` limit make
+            # tick 3 trip — no sleeps, no real anomaly needed.
+            def burst_request(value: str) -> "rls_pb2.RateLimitRequest":
+                req = rls_pb2.RateLimitRequest(domain="smoke")
+                d = req.descriptors.add()
+                e = d.entries.add()
+                e.key, e.value = "burst", value
+                return req
+
+            runner.detectors.tick()  # prime
+            with grpc.insecure_channel(
+                f"127.0.0.1:{runner.grpc_server.bound_port}"
+            ) as channel:
+                method = channel.unary_unary(
+                    "/envoy.service.ratelimit.v3.RateLimitService/"
+                    "ShouldRateLimit",
+                    request_serializer=(
+                        rls_pb2.RateLimitRequest.SerializeToString
+                    ),
+                    response_deserializer=rls_pb2.RateLimitResponse.FromString,
+                )
+                for _ in range(8):  # calm baseline traffic
+                    method(request_for("baseline"), timeout=60)
+                assert runner.detectors.tick() == []  # seeds baselines
+                over_limit_seen = 0
+                for _ in range(20):  # the anomaly: a burst key storm
+                    resp = method(burst_request("storm"), timeout=60)
+                    if resp.overall_code == rls_pb2.RateLimitResponse.OVER_LIMIT:
+                        over_limit_seen += 1
+                assert over_limit_seen >= 10, over_limit_seen
+            hist = runner.stats_manager.store.histogram(
+                "ratelimit_server.ShouldRateLimit.response_ms"
+            )
+            for _ in range(50):  # the injected latency spike
+                hist.observe(800.0)
+            incidents = runner.detectors.tick()
+            tripped = {i["detector"] for i in incidents}
+            assert "latency_spike" in tripped, incidents
+            assert "over_limit_surge" in tripped, incidents
+
+            # Bounded incident JSON on disk, with a non-empty ring
+            # snapshot of the decisions around the anomaly.
+            incident_files = sorted(
+                (Path(tmp) / "incidents").glob("incident_*.json")
+            )
+            assert incident_files, "no incident file written"
+            on_disk = json.loads(incident_files[-1].read_text())
+            assert on_disk["ring"], "incident ring snapshot is empty"
+            assert any(
+                rec["domain"] == "smoke" for rec in on_disk["ring"]
+            ), on_disk["ring"][:3]
+
+            # ...and the same incidents round-trip over the endpoint.
+            served = json.loads(get("/debug/incidents"))
+            assert served["captured_total"] == len(incidents), served
+            assert {i["id"] for i in served["incidents"]} == {
+                i["id"] for i in incidents
+            }
+            assert served["incidents"][0]["ring"], served["incidents"][0]
+
+            # Per-domain SLO burn-rate family on /metrics (float
+            # gauges) + the rollup counters, and the /debug/slo view.
+            metrics = get("/metrics")
+            for family in (
+                "ratelimit_tpu_slo_smoke_burn_rate",
+                "ratelimit_tpu_slo_smoke_latency_burn_rate",
+                "ratelimit_tpu_slo_smoke_availability",
+                "ratelimit_tpu_slo_smoke_requests",
+                "ratelimit_tpu_slo_smoke_over_limit",
+                "ratelimit_incidents_captured",
+                "ratelimit_tpu_flight_stamped",
+            ):
+                assert family in metrics, family
+            slo = json.loads(get("/debug/slo"))
+            assert slo["domains"]["smoke"]["cumulative"]["over_limit"] >= 10
+            assert slo["domains"]["smoke"]["window"]["requests"] > 0
+
+            # The generated /debug/ index lists every GET endpoint.
+            index = get("/debug/")
+            for path in ("/debug/incidents", "/debug/slo", "/debug/tracez"):
+                assert path in index, (path, index)
 
             print(
                 json.dumps(

@@ -31,3 +31,31 @@ def ok_wall_stamp():
 
 def ok_wall_addition():
     return time.time() + 5  # clean: building a deadline stamp
+
+
+def elapsed_datetime(start_dt):
+    from datetime import datetime
+
+    return (datetime.now() - start_dt).total_seconds()  # line 39: datetime.now
+
+
+def elapsed_utcnow_via_names():
+    import datetime
+
+    d0 = datetime.datetime.utcnow()
+    d1 = datetime.datetime.utcnow()
+    return d1 - d0  # line 47: both names bound from utcnow()
+
+
+def elapsed_datetime_aliased(work):
+    from datetime import datetime as dt
+
+    t0 = dt.now()
+    work()
+    return dt.now() - t0  # line 55: aliased import, right + left
+
+
+def ok_datetime_stamp():
+    from datetime import datetime
+
+    return {"saved_at": datetime.now()}  # clean: storing a timestamp

@@ -1,0 +1,459 @@
+"""Anomaly detectors, incident capture, and the per-domain SLO engine
+(observability/{detectors,slo}.py), all on synthetic time (the
+MonotonicClock seam, utils/time.py) — no sleeps.  Also covers the new
+debug surfaces (/debug/slo, /debug/incidents, the generated /debug/
+index) and statsd parity for the fn-backed SLO rollups."""
+
+import json
+import socket
+import urllib.request
+
+import pytest
+
+from ratelimit_tpu.observability import (
+    AnomalyDetectors,
+    ErrorRateDetector,
+    Ewma,
+    LatencySpikeDetector,
+    OverLimitSurgeDetector,
+    QueueSaturationDetector,
+    SloEngine,
+    make_flight_recorder,
+)
+from ratelimit_tpu.observability.detectors import quantile_from_counts
+from ratelimit_tpu.stats.manager import Manager, StatsStore
+from ratelimit_tpu.stats.statsd import StatsdExporter
+from ratelimit_tpu.utils.time import FakeMonotonicClock
+
+
+def make_slo(**kw):
+    mgr = Manager()
+    clock = kw.pop("clock", FakeMonotonicClock(1000.0))
+    engine = SloEngine(mgr, clock=clock, **kw)
+    return engine, mgr, clock
+
+
+# -- EWMA + quantile helpers -------------------------------------------------
+
+
+def test_ewma_seeds_on_first_observation():
+    e = Ewma(alpha=0.5)
+    assert e.value is None
+    assert e.update(10.0) == 10.0
+    assert e.update(20.0) == pytest.approx(15.0)
+
+
+def test_quantile_from_counts_interpolates():
+    bounds = (1.0, 2.0, 4.0)
+    # 10 observations in (1, 2]: p50 falls mid-bucket.
+    assert quantile_from_counts(bounds, [0, 10, 0, 0], 0.5) == pytest.approx(1.5)
+    assert quantile_from_counts(bounds, [0, 0, 0, 0], 0.99) == 0.0
+    # Overflow bucket clamps to the last finite bound.
+    assert quantile_from_counts(bounds, [0, 0, 0, 5], 0.99) == 4.0
+
+
+# -- individual detectors ----------------------------------------------------
+
+
+def test_latency_spike_detector_needs_baseline_then_trips():
+    store = StatsStore()
+    hist = store.histogram("rt_ms")
+    det = LatencySpikeDetector(hist, factor=4.0, min_samples=10)
+
+    def tick_with(ms, n=50):
+        for _ in range(n):
+            hist.observe(ms)
+        return det.evaluate()
+
+    assert det.evaluate() is None  # first tick: primes the delta
+    assert tick_with(2.0) is None  # second: seeds the EWMA baseline
+    assert tick_with(2.0) is None  # steady state stays quiet
+    reason = tick_with(400.0)  # 200x the baseline
+    assert reason is not None and "p99 latency" in reason
+
+
+def test_latency_spike_detector_ignores_thin_traffic():
+    store = StatsStore()
+    hist = store.histogram("rt_ms")
+    det = LatencySpikeDetector(hist, factor=4.0, min_samples=10)
+    det.evaluate()
+    for _ in range(3):
+        hist.observe(1.0)
+    assert det.evaluate() is None  # 3 < min_samples: no baseline, no trip
+    for _ in range(3):
+        hist.observe(500.0)
+    assert det.evaluate() is None
+
+
+def test_over_limit_surge_detector_per_domain():
+    engine, _mgr, _clock = make_slo()
+    engine.set_domains(["api", "web"])
+    det = OverLimitSurgeDetector(engine, factor=4.0, min_requests=10)
+
+    def traffic(domain, total, over):
+        for i in range(total):
+            engine.observe(domain, over_limit=i < over, latency_ms=1.0)
+
+    traffic("api", 100, 2)
+    assert det.evaluate() is None  # seeds the per-domain baseline
+    traffic("api", 100, 2)
+    assert det.evaluate() is None  # steady 2%
+    traffic("api", 100, 90)  # surge to 90%
+    reason = det.evaluate()
+    assert reason is not None and "'api'" in reason and "90" in reason
+    # The quiet domain must not be implicated.
+    assert "web" not in reason
+
+
+def test_queue_saturation_detector_threshold():
+    depths = [0, 100, 900]
+    det = QueueSaturationDetector(lambda: depths.pop(0), threshold=512)
+    assert det.evaluate() is None
+    assert det.evaluate() is None
+    assert "queue depth" in det.evaluate()
+
+
+def test_error_rate_detector():
+    store = StatsStore()
+    det = ErrorRateDetector(store, threshold=0.05, min_errors=5)
+    requests = store.counter("ratelimit_server.ShouldRateLimit.total_requests")
+    errors = store.counter(
+        "ratelimit.service.call.should_rate_limit.redis_error"
+    )
+    requests.add(100)
+    assert det.evaluate() is None  # clean tick
+    requests.add(100)
+    errors.add(50)
+    reason = det.evaluate()
+    assert reason is not None and "errors" in reason
+    # Errors below the count floor never trip, whatever the ratio.
+    errors.add(2)
+    assert det.evaluate() is None
+
+
+# -- orchestration + incident capture ----------------------------------------
+
+
+class TripOnce:
+    name = "synthetic"
+
+    def __init__(self):
+        self.reasons = []
+
+    def evaluate(self):
+        return self.reasons.pop(0) if self.reasons else None
+
+
+def test_tick_captures_incident_with_evidence(tmp_path):
+    clock = FakeMonotonicClock(50.0)
+    engine, mgr, _ = make_slo(clock=clock)
+    engine.set_domains(["api"])
+    engine.observe("api", over_limit=True, latency_ms=3.0)
+    flight = make_flight_recorder(32, clock=clock)
+    flight.note(0xBEEF, 0)
+    flight.record("api", 2, 1, 3.0)
+    det = TripOnce()
+    det.reasons = ["synthetic anomaly for test"]
+    dets = AnomalyDetectors(
+        mgr.store,
+        [det],
+        flight=flight,
+        slo=engine,
+        incident_dir=str(tmp_path),
+        incident_max=4,
+        clock=clock,
+    )
+    captured = dets.tick()
+    assert len(captured) == 1
+    inc = captured[0]
+    assert inc["detector"] == "synthetic"
+    assert inc["reason"] == "synthetic anomaly for test"
+    assert inc["ring"][0]["stem_hash"] == f"{0xBEEF:08x}"
+    assert inc["slo"]["domains"]["api"]["cumulative"]["over_limit"] == 1
+    # On-disk mirror round-trips as JSON.
+    files = sorted(tmp_path.glob("incident_*.json"))
+    assert len(files) == 1
+    on_disk = json.loads(files[0].read_text())
+    assert on_disk["id"] == inc["id"]
+    assert on_disk["ring"][0]["stem_hash"] == f"{0xBEEF:08x}"
+    # In-memory ring serves the same incident.
+    assert dets.incidents()[0]["id"] == inc["id"]
+    assert dets.captured == 1
+
+
+def test_cooldown_suppresses_repeat_trips_until_elapsed(tmp_path):
+    clock = FakeMonotonicClock(0.0)
+    det = TripOnce()
+    det.reasons = ["a", "b", "c"]
+    dets = AnomalyDetectors(
+        StatsStore(), [det], cooldown_s=60.0, clock=clock
+    )
+    assert len(dets.tick()) == 1
+    clock.advance(10)
+    assert dets.tick() == []  # inside cooldown: "b" is swallowed
+    clock.advance(60)
+    assert len(dets.tick()) == 1  # cooldown elapsed: "c" captures
+
+
+def test_incident_retention_is_bounded(tmp_path):
+    clock = FakeMonotonicClock(0.0)
+    det = TripOnce()
+    det.reasons = [f"r{i}" for i in range(10)]
+    dets = AnomalyDetectors(
+        StatsStore(),
+        [det],
+        incident_dir=str(tmp_path),
+        incident_max=3,
+        cooldown_s=0.0,
+        clock=clock,
+    )
+    for _ in range(10):
+        dets.tick()
+        clock.advance(1)
+    assert dets.captured == 10
+    assert len(dets.incidents()) == 3
+    assert len(list(tmp_path.glob("incident_*.json"))) == 3
+    # Newest first, oldest pruned.
+    assert dets.incidents()[0]["reason"] == "r9"
+
+
+def test_detector_exceptions_do_not_kill_the_tick():
+    class Broken:
+        name = "broken"
+
+        def evaluate(self):
+            raise RuntimeError("boom")
+
+    ok = TripOnce()
+    ok.reasons = ["fine"]
+    dets = AnomalyDetectors(
+        StatsStore(), [Broken(), ok], clock=FakeMonotonicClock(0.0)
+    )
+    assert [i["reason"] for i in dets.tick()] == ["fine"]
+
+
+def test_register_stats_counts_captures():
+    store = StatsStore()
+    det = TripOnce()
+    det.reasons = ["x"]
+    dets = AnomalyDetectors(store, [det], clock=FakeMonotonicClock(0.0))
+    dets.register_stats(store)
+    dets.tick()
+    counters = store.counters()
+    assert counters["ratelimit.incidents.captured"] == 1
+    assert counters["ratelimit.incidents.synthetic"] == 1
+    assert store.gauges()["ratelimit.incidents.retained"] == 1
+
+
+# -- SLO engine ---------------------------------------------------------------
+
+
+def test_slo_windows_and_burn_rate_with_synthetic_time():
+    engine, mgr, clock = make_slo(
+        target=0.99, window_s=100.0, latency_threshold_ms=10.0
+    )
+    engine.set_domains(["api"])
+    # 100 requests: 2 errors, 10 slow.
+    for i in range(98):
+        engine.observe("api", over_limit=False, latency_ms=50.0 if i < 10 else 1.0)
+    for _ in range(2):
+        engine.observe_error("api")
+    engine.roll()
+    s = engine.summary()["domains"]["api"]["window"]
+    assert s["requests"] == 100
+    assert s["errors"] == 2
+    assert s["slow"] == 10
+    assert s["availability"] == pytest.approx(0.98)
+    assert s["latency_sli"] == pytest.approx(0.90)
+    # budget = 1%; 2% bad => burn 2x; 10% slow => latency burn 10x.
+    assert s["burn_rate"] == pytest.approx(2.0)
+    assert s["latency_burn_rate"] == pytest.approx(10.0)
+
+    # Advance past the window with clean traffic: burn decays to 0.
+    for t in range(12):
+        clock.advance(10.0)
+        for _ in range(10):
+            engine.observe("api", over_limit=False, latency_ms=1.0)
+        engine.roll()
+    s = engine.summary()["domains"]["api"]["window"]
+    assert s["errors"] == 0
+    assert s["burn_rate"] == 0.0
+    assert s["availability"] == 1.0
+
+
+def test_slo_idle_domain_reads_healthy():
+    engine, _mgr, _clock = make_slo()
+    engine.set_domains(["idle"])
+    engine.roll()
+    s = engine.summary()["domains"]["idle"]["window"]
+    assert s["availability"] == 1.0
+    assert s["burn_rate"] == 0.0
+
+
+def test_slo_unconfigured_domain_folds_into_other():
+    engine, mgr, _clock = make_slo()
+    engine.set_domains(["api"])
+    engine.observe("unconfigured", over_limit=False, latency_ms=1.0)
+    engine.observe("another-stranger", over_limit=True, latency_ms=1.0)
+    s = mgr.slo_stats("_other")
+    assert s.requests == 2
+    assert s.over_limit == 1
+    # No per-domain family was minted for the strangers.
+    assert "ratelimit.tpu.slo.unconfigured.requests" not in mgr.store.counters()
+
+
+def test_slo_metric_families_on_store():
+    engine, mgr, _clock = make_slo(target=0.999)
+    engine.set_domains(["api"])
+    engine.observe("api", over_limit=True, latency_ms=1.0)
+    counters = mgr.store.counters()
+    assert counters["ratelimit.tpu.slo.api.requests"] == 1
+    assert counters["ratelimit.tpu.slo.api.over_limit"] == 1
+    fg = mgr.store.float_gauges()
+    assert fg["ratelimit.tpu.slo.api.availability"] == 1.0
+    assert fg["ratelimit.tpu.slo.api.burn_rate"] == 0.0
+    # Burn rates render on the Prometheus exposition as gauges.
+    from ratelimit_tpu.observability import prometheus
+
+    text = prometheus.render(mgr.store)
+    assert "# TYPE ratelimit_tpu_slo_api_burn_rate gauge" in text
+
+
+def test_manager_slo_interning_is_idempotent_and_bounded():
+    from ratelimit_tpu.stats.manager import MAX_SLO_DOMAINS
+
+    mgr = Manager()
+    a = mgr.slo_stats("d")
+    assert mgr.slo_stats("d") is a
+    for i in range(MAX_SLO_DOMAINS + 10):
+        mgr.slo_stats(f"flood-{i}")
+    overflow = mgr.slo_stats("one-more")
+    assert overflow.domain == "_other"
+
+
+# -- statsd parity (counter_fn delta-cursor path) -----------------------------
+
+
+def test_statsd_flushes_slo_rollups_and_incident_counter_as_deltas():
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    recv.settimeout(5)
+    port = recv.getsockname()[1]
+
+    engine, mgr, clock = make_slo()
+    engine.set_domains(["api"])
+    det = TripOnce()
+    det.reasons = ["x"]
+    dets = AnomalyDetectors(mgr.store, [det], clock=FakeMonotonicClock(0.0))
+    dets.register_stats(mgr.store)
+
+    engine.observe("api", over_limit=True, latency_ms=1.0)
+    engine.observe("api", over_limit=False, latency_ms=1.0)
+    dets.tick()
+
+    exporter = StatsdExporter(mgr.store, "127.0.0.1", port, interval_s=60)
+    exporter.flush()
+    payload = recv.recv(65536).decode()
+    lines = set(payload.split("\n"))
+    assert "ratelimit.tpu.slo.api.requests:2|c" in lines
+    assert "ratelimit.tpu.slo.api.over_limit:1|c" in lines
+    assert "ratelimit.incidents.captured:1|c" in lines
+    # Float gauges ride along as |g.
+    assert "ratelimit.tpu.slo.api.availability:1|g" in lines
+
+    # Delta cursor: an unchanged rollup emits nothing next flush…
+    engine.observe("api", over_limit=False, latency_ms=1.0)
+    exporter.flush()
+    payload = recv.recv(65536).decode()
+    assert "ratelimit.tpu.slo.api.requests:1|c" in payload.split("\n")
+    assert "over_limit" not in payload
+    assert "incidents.captured" not in payload
+    exporter.stop()
+    recv.close()
+
+
+# -- debug endpoints ----------------------------------------------------------
+
+
+@pytest.fixture
+def debug_server():
+    from ratelimit_tpu.server.http_server import HttpServer, add_debug_routes
+
+    engine, mgr, clock = make_slo()
+    engine.set_domains(["api"])
+    engine.observe("api", over_limit=False, latency_ms=1.0)
+    det = TripOnce()
+    det.reasons = ["endpoint test"]
+    dets = AnomalyDetectors(
+        mgr.store, [det], slo=engine, clock=FakeMonotonicClock(0.0)
+    )
+    dets.tick()
+    server = HttpServer("127.0.0.1", 0, name="debug-test")
+    add_debug_routes(server, mgr.store, detectors=dets, slo=engine)
+    server.start()
+    yield server
+    server.stop()
+
+
+def get(server, path: str) -> str:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{server.bound_port}{path}", timeout=10
+    ) as r:
+        assert r.status == 200
+        return r.read().decode()
+
+
+def test_debug_slo_endpoint(debug_server):
+    body = json.loads(get(debug_server, "/debug/slo"))
+    assert body["target"] == 0.999
+    assert "api" in body["domains"]
+    assert body["domains"]["api"]["cumulative"]["requests"] == 1
+
+
+def test_debug_incidents_endpoint(debug_server):
+    body = json.loads(get(debug_server, "/debug/incidents"))
+    assert body["captured_total"] == 1
+    assert body["incidents"][0]["reason"] == "endpoint test"
+    assert "slo" in body["incidents"][0]
+
+
+def test_debug_index_lists_every_registered_get_route(debug_server):
+    """The /debug/ index is generated from the live router, so every
+    registered GET endpoint must appear — including the ones this PR
+    added — and carry a blurb (an undescribed endpoint means
+    ENDPOINT_BLURBS needs a line)."""
+    from ratelimit_tpu.server.debug_profiling import ENDPOINT_BLURBS
+
+    index = get(debug_server, "/debug/")
+    registered = sorted(
+        path
+        for method, path in debug_server.router.routes
+        if method == "GET"
+    )
+    for path in registered:
+        assert path in index, f"{path} missing from /debug/ index"
+        assert path in ENDPOINT_BLURBS, f"{path} has no index blurb"
+    for expected in ("/debug/incidents", "/debug/slo", "/debug/hotkeys"):
+        assert expected in registered
+    # The pprof alias serves the same index.
+    assert get(debug_server, "/debug/pprof/") == index
+
+
+def test_debug_endpoints_404_when_disabled():
+    from ratelimit_tpu.server.http_server import HttpServer, add_debug_routes
+
+    server = HttpServer("127.0.0.1", 0, name="debug-test2")
+    add_debug_routes(server, StatsStore())
+    server.start()
+    try:
+        for path in ("/debug/incidents", "/debug/slo"):
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.bound_port}{path}", timeout=10
+                )
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+            else:
+                raise AssertionError(f"{path} should 404 when unwired")
+    finally:
+        server.stop()

@@ -1,0 +1,163 @@
+"""Flight recorder ring (observability/flight.py): wraparound,
+concurrent stamping, snapshot-during-write consistency, the
+thread-local note seam, domain interning bounds, and the disabled
+(FLIGHT_RECORDER_SIZE=0) zero-cost path."""
+
+import threading
+
+import numpy as np
+
+from ratelimit_tpu.observability import FLIGHT_DTYPE, make_flight_recorder
+from ratelimit_tpu.observability.flight import MAX_DOMAINS, FlightRecorder
+from ratelimit_tpu.stats.manager import StatsStore
+from ratelimit_tpu.utils.time import FakeMonotonicClock
+
+
+def test_disabled_mode_returns_none():
+    assert make_flight_recorder(0) is None
+    assert make_flight_recorder(-5) is None
+    assert isinstance(make_flight_recorder(4), FlightRecorder)
+
+
+def test_record_and_snapshot_fields():
+    clock = FakeMonotonicClock(10.0)
+    fr = FlightRecorder(16, clock=clock)
+    fr.note(0xDEAD, 2)
+    fr.record("prod", 2, 5, 0.4)
+    live = fr.snapshot()
+    assert live.dtype == FLIGHT_DTYPE
+    assert len(live) == 1
+    rec = live[0]
+    assert rec["seq"] == 1
+    assert rec["ts_ns"] == int(10.0 * 1e9)
+    assert rec["stem"] == 0xDEAD
+    assert rec["lane"] == 2
+    assert rec["code"] == 2
+    assert rec["hits"] == 5
+    # 0.4ms lands in the (0.25, 0.5] bucket of the shared ladder.
+    d = fr.snapshot_dicts()[0]
+    assert d["domain"] == "prod"
+    assert d["latency_le_ms"] == 0.5
+    assert d["stem_hash"] == f"{0xDEAD:08x}"
+
+
+def test_note_is_consumed_per_record():
+    fr = FlightRecorder(8)
+    fr.note(7, 1)
+    fr.record("d", 1, 1, 0.1)
+    # The next record on this thread must NOT inherit the note.
+    fr.record("d", 1, 1, 0.1)
+    live = fr.snapshot()
+    assert live["stem"].tolist() == [7, 0]
+    assert live["lane"].tolist() == [1, -1]
+
+
+def test_wraparound_keeps_latest_records():
+    fr = FlightRecorder(8)
+    for i in range(20):
+        fr.record("d", 1, i + 1, 0.1)
+    live = fr.snapshot()
+    assert len(live) == 8
+    # Oldest-first, exactly the last 8 stamps.
+    assert live["seq"].tolist() == list(range(13, 21))
+    assert live["hits"].tolist() == list(range(13, 21))
+    assert fr.stamped() == 20
+
+
+def test_hits_addend_clamped_to_at_least_one():
+    fr = FlightRecorder(4)
+    fr.record("d", 1, 0, 0.1)  # proto default 0 means 1
+    assert fr.snapshot()["hits"].tolist() == [1]
+
+
+def test_domain_interning_is_bounded():
+    fr = FlightRecorder(4)
+    for i in range(MAX_DOMAINS + 50):
+        fr.record(f"domain-{i}", 1, 1, 0.1)
+    names = fr.domain_names()
+    assert len(names) == MAX_DOMAINS
+    # Overflow domains share the "_other" id (0).
+    assert fr.snapshot_dicts()[0]["domain"] == "_other"
+
+
+def test_concurrent_stamping_from_many_threads():
+    """RPC-thread contract: concurrent stampers never tear a record —
+    every snapshot row is internally consistent (stem == hits * 7 + 1,
+    a writer-enforced invariant) and seqs are unique."""
+    fr = FlightRecorder(256)
+    n_threads, per_thread = 8, 2000
+    start = threading.Barrier(n_threads)
+
+    def stamp(tid: int):
+        start.wait()
+        for j in range(per_thread):
+            x = tid * per_thread + j
+            fr.note(x * 7 + 1, tid)
+            fr.record("d", 1, x, 0.1)
+
+    threads = [
+        threading.Thread(target=stamp, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    live = fr.snapshot()
+    assert len(live) == 256  # full ring, only live lap retained
+    assert fr.stamped() == n_threads * per_thread
+    seqs = live["seq"].tolist()
+    assert len(set(seqs)) == len(seqs)
+    assert seqs == sorted(seqs)
+    # No torn rows: note and hits were written by the same thread.
+    assert (live["stem"] == live["hits"] * 7 + 1).all()
+
+
+def test_snapshot_during_concurrent_writes_is_consistent():
+    """Readers racing writers only ever see complete rows whose seq
+    falls inside the live window."""
+    fr = FlightRecorder(64)
+    stop = threading.Event()
+    errors = []
+
+    def writer(tid: int):
+        j = 0
+        while not stop.is_set():
+            fr.note(j * 7 + 1, tid)
+            fr.record("d", 1, j, 0.05)
+            j += 1
+
+    def reader():
+        while not stop.is_set():
+            live = fr.snapshot()
+            if len(live) == 0:
+                continue
+            seqs = live["seq"]
+            if not (live["stem"] == live["hits"] * 7 + 1).all():
+                errors.append("torn row")
+            if len(np.unique(seqs)) != len(seqs):
+                errors.append("duplicate seq")
+            hwm = int(seqs.max())
+            if int(seqs.min()) <= hwm - fr.size:
+                errors.append("stale lap row")
+
+    threads = [threading.Thread(target=writer, args=(t,)) for t in range(4)]
+    threads += [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    timer = threading.Timer(0.5, stop.set)
+    timer.start()
+    for t in threads:
+        t.join()
+    timer.cancel()
+    assert errors == []
+
+
+def test_register_stats_family():
+    fr = FlightRecorder(32)
+    store = StatsStore()
+    fr.register_stats(store)
+    fr.record("d", 1, 1, 0.1)
+    fr.record("d", 1, 1, 0.1)
+    assert store.gauges()["ratelimit.tpu.flight.capacity"] == 32
+    assert store.counters()["ratelimit.tpu.flight.stamped"] == 2

@@ -72,9 +72,10 @@ def test_dtype_rule_fires_on_seeded_violations():
 def test_timing_rule_fires_on_seeded_violations():
     findings = lint(FIXTURES / "timing_violation.py")
     # direct-call subtraction, name-bound subtraction, wall clock as
-    # the right operand — and nothing else (monotonic durations, wall
-    # stamps, and deadline ADDITION stay quiet).
-    assert lines_for(findings, "timing-discipline") == [7, 14, 18]
+    # the right operand, plus the datetime.now()/utcnow() trio (direct
+    # call, name-bound, aliased import) — and nothing else (monotonic
+    # durations, wall stamps, and deadline ADDITION stay quiet).
+    assert lines_for(findings, "timing-discipline") == [7, 14, 18, 39, 47, 55]
     assert all(f.rule_id == "timing-discipline" for f in findings)
 
 

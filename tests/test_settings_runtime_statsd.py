@@ -148,3 +148,56 @@ def test_observability_env_knobs_parse(monkeypatch):
     s = new_settings()
     assert s.hotkeys_top_k == 0
     assert s.debug_profiling is True
+
+
+def test_flight_slo_anomaly_env_knobs_parse(monkeypatch):
+    """Flight recorder / detectors / SLO engine env names are locked
+    (docs/OBSERVABILITY.md, docs/INCIDENT_RUNBOOK.md)."""
+    from ratelimit_tpu.settings import new_settings
+
+    for var in (
+        "FLIGHT_RECORDER_SIZE",
+        "ANOMALY_INTERVAL_S",
+        "INCIDENT_DIR",
+        "SLO_TARGET",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    s = new_settings()
+    assert s.flight_recorder_size == 4096
+    assert s.anomaly_interval_s == pytest.approx(5.0)
+    assert s.anomaly_spike_factor == pytest.approx(4.0)
+    assert s.anomaly_min_samples == 20
+    assert s.anomaly_queue_depth == 512
+    assert s.anomaly_cooldown_s == pytest.approx(60.0)
+    assert s.incident_dir == ""
+    assert s.incident_max == 16
+    assert s.slo_target == pytest.approx(0.999)
+    assert s.slo_window_s == pytest.approx(3600.0)
+    assert s.slo_latency_ms == pytest.approx(50.0)
+
+    for k, v in {
+        "FLIGHT_RECORDER_SIZE": "0",
+        "ANOMALY_INTERVAL_S": "1.5",
+        "ANOMALY_SPIKE_FACTOR": "8",
+        "ANOMALY_MIN_SAMPLES": "5",
+        "ANOMALY_QUEUE_DEPTH": "64",
+        "ANOMALY_COOLDOWN_S": "10",
+        "INCIDENT_DIR": "/tmp/incidents",
+        "INCIDENT_MAX": "4",
+        "SLO_TARGET": "0.99",
+        "SLO_WINDOW_S": "600",
+        "SLO_LATENCY_MS": "25",
+    }.items():
+        monkeypatch.setenv(k, v)
+    s = new_settings()
+    assert s.flight_recorder_size == 0
+    assert s.anomaly_interval_s == pytest.approx(1.5)
+    assert s.anomaly_spike_factor == pytest.approx(8.0)
+    assert s.anomaly_min_samples == 5
+    assert s.anomaly_queue_depth == 64
+    assert s.anomaly_cooldown_s == pytest.approx(10.0)
+    assert s.incident_dir == "/tmp/incidents"
+    assert s.incident_max == 4
+    assert s.slo_target == pytest.approx(0.99)
+    assert s.slo_window_s == pytest.approx(600.0)
+    assert s.slo_latency_ms == pytest.approx(25.0)
