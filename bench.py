@@ -1,9 +1,16 @@
-"""Headline benchmark: fixed-window decisions/sec on one chip.
+"""Headline benchmark: limiter decisions/sec on one chip, for every
+kernel in the algorithm table.
 
 What is measured: the serving device step — the TPU-native replacement
 for the reference's Redis INCRBY+EXPIRE round trip
 (reference src/redis/fixed_cache_impl.go:33-113) — at the largest
-serving bucket (4096 lanes), steady state, on the real chip.
+serving bucket (4096 lanes), steady state, on the real chip.  The
+fixed-window kernel remains the headline metric; the pluggable
+sliding-window and GCRA kernels (models/registry.py,
+docs/ALGORITHMS.md) each get a shorter timed section so BENCH
+artifacts record decisions/s for all three (the per-algorithm numbers
+ride the final record's "algorithms" field plus one JSON event line
+each).
 
 Protocol (see benchmarks/PERF_NOTES.md for the measurements that shaped
 it):
@@ -56,6 +63,17 @@ NUM_SLOTS = 1 << 20
 STEPS_PER_CALL = 256  # one full permutation of the slot space
 CALLS = 128
 LIMIT_MAX = 1000
+# The per-algorithm sections are shorter: they exist to RECORD each
+# kernel's throughput beside the headline, not to re-anchor it.
+ALGO_STEPS_PER_CALL = 64
+ALGO_CALLS = 16
+#: GCRA bench limits are divisors of the 60-second divider, so the
+#: emission interval T = 60/limit is an exact f32 integer and the
+#: whole kernel runs in exactly-representable arithmetic — the numpy
+#: replay can then verify digests BIT-exactly (with fractional T, XLA
+#: is free to fuse the TAT reconstruction into an FMA and wobble a
+#: budget by one cell across a floor() boundary).
+GCRA_LIMITS = (2, 3, 4, 5, 6, 10, 12, 15, 20, 30, 60)
 
 
 def _bound_device_discovery() -> str:
@@ -109,6 +127,111 @@ def _bound_device_discovery() -> str:
         )
         return "cpu_fallback"
     return "default"
+
+
+def _bench_algorithm(name: str) -> float:
+    """Timed steady-state section for one generic-algorithm kernel
+    (models/registry.py step_serve_packed protocol): device-resident
+    int32[5, BATCH] packed batches over unique slots, scanned
+    STEPS_PER_CALL at a time, digest-folded so nothing is dead code,
+    then verified against the model's numpy reference_step replay —
+    state and readback bit-exact (inputs are chosen so every f32
+    intermediate is exactly representable; see GCRA_LIMITS).
+    Returns decisions/sec."""
+    import functools
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ratelimit_tpu.models.registry import get_algorithm
+
+    model = get_algorithm(name).make_model(NUM_SLOTS, 0.8)
+    state = model.init_state()
+    k = ALGO_STEPS_PER_CALL
+    now_host = 1_700_000_040  # window-aligned: divider 60 divides it
+
+    key = jax.random.key(17)
+    k_perm, k_hits, k_lim = jax.random.split(key, 3)
+    perm = jax.random.permutation(k_perm, NUM_SLOTS).astype(jnp.int32)
+    slots = perm[: k * BATCH].reshape(k, BATCH)
+    hits = jax.random.randint(k_hits, (k, BATCH), 1, 4, jnp.int32)
+    if name == "gcra":
+        limits = jnp.asarray(np.array(GCRA_LIMITS, np.int32))[
+            jax.random.randint(k_lim, (k, BATCH), 0, len(GCRA_LIMITS))
+        ]
+    else:
+        limits = jax.random.randint(k_lim, (k, BATCH), 1, LIMIT_MAX, jnp.int32)
+    packed = jnp.stack(
+        [
+            slots,
+            hits,
+            limits,
+            jnp.zeros((k, BATCH), jnp.int32),  # fresh: lazy reset path
+            jnp.full((k, BATCH), 60, jnp.int32),  # divider
+        ],
+        axis=1,
+    )  # (k, 5, BATCH)
+    now = jnp.asarray(now_host, jnp.int32)
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def run_pipeline(state, packed):
+        def body(st, pk):
+            st, out = model.step_serve_packed(st, pk, now)
+            return st, jnp.sum(
+                out.astype(jnp.uint32), dtype=jnp.uint32
+            )  # modular digest; replayed on host
+
+        state, digests = jax.lax.scan(body, state, packed)
+        return state, jnp.sum(digests, dtype=jnp.uint32)
+
+    state, digest = run_pipeline(state, packed)  # compile+warm
+    warm_digest = int(jax.device_get(digest))
+
+    start = time.perf_counter()
+    outs = []
+    for _ in range(ALGO_CALLS):
+        state, digest = run_pipeline(state, packed)
+        outs.append(digest)
+    fetched = jax.device_get(outs)
+    elapsed = time.perf_counter() - start
+
+    # --- verification (untimed): numpy replay of every batch ----------
+    h_slots = np.asarray(jax.device_get(slots))
+    h_hits = np.asarray(jax.device_get(hits)).astype(np.uint32)
+    h_limits = np.asarray(jax.device_get(limits)).astype(np.uint32)
+    rows = len(model.state_rows)
+    ref = np.zeros((rows, NUM_SLOTS), np.uint32)
+    fresh = np.zeros(BATCH, bool)
+    divider = np.full(BATCH, 60, np.uint32)
+    digests = np.zeros(1 + ALGO_CALLS, np.uint32)
+    for call in range(1 + ALGO_CALLS):
+        acc = np.uint32(0)
+        for s in range(k):
+            out = model.reference_step(
+                ref, h_slots[s], h_hits[s], h_limits[s], fresh, divider,
+                now_host,
+            )
+            flat = (
+                np.concatenate([o.reshape(-1) for o in out])
+                if isinstance(out, tuple)
+                else out.reshape(-1)
+            )
+            acc = np.uint32(
+                acc + np.uint32(flat.astype(np.uint32).sum(dtype=np.uint32))
+            )
+        digests[call] = acc
+    assert warm_digest == int(digests[0]), (
+        name, "warmup digest", warm_digest, int(digests[0]),
+    )
+    for i, d in enumerate(fetched):
+        assert int(d) == int(digests[1 + i]), (name, "digest call", i)
+
+    final_state = np.asarray(jax.device_get(state))
+    np.testing.assert_array_equal(final_state, ref, err_msg=name)
+
+    return BATCH * k * ALGO_CALLS / elapsed
 
 
 def main() -> None:
@@ -240,6 +363,25 @@ def main() -> None:
         np.testing.assert_array_equal(np.asarray(t), tails[1 + i])
 
     decisions_per_sec = decisions / elapsed
+
+    # --- pluggable-algorithm kernels (models/registry.py) -------------
+    algorithms = {"fixed_window": round(decisions_per_sec, 1)}
+    for algo in ("sliding_window", "gcra"):
+        dps = _bench_algorithm(algo)
+        algorithms[algo] = round(dps, 1)
+        print(
+            json.dumps(
+                {
+                    "event": "algorithm_bench",
+                    "algorithm": algo,
+                    "value": round(dps, 1),
+                    "unit": "decisions/s/chip",
+                    "platform": platform,
+                }
+            ),
+            flush=True,
+        )
+
     print(
         json.dumps(
             {
@@ -250,6 +392,7 @@ def main() -> None:
                     decisions_per_sec / BASELINE_DECISIONS_PER_SEC, 4
                 ),
                 "platform": platform,
+                "algorithms": algorithms,
             }
         )
     )

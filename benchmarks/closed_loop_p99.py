@@ -166,7 +166,7 @@ def staged_closed_loop(cache, workers: int = 4, n_traced: int = 400):
             ]
             meta = np.empty(DESCRIPTORS, dtype=LANE_DTYPE)
             for j, b in enumerate(enc):
-                meta[j] = (1_700_003_600, 1, 1_000_000, len(b), 0)
+                meta[j] = (1_700_003_600, 1, 1_000_000, len(b), 0, 0, 0)
             applied_at = {}
 
             def apply(decisions, applied_at=applied_at):

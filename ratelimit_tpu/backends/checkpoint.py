@@ -36,22 +36,36 @@ FORMAT_VERSION = 1
 
 
 def snapshot_engine(engine) -> tuple:
-    """Copy one bank's state: (counts, entries).  This is the only
-    part that needs exclusive access to the engine; serialization and
-    disk I/O happen afterwards on the caller's thread."""
-    return engine.export_counts(), engine.slot_table.entries()
+    """Copy one bank's state: (state dict, entries).  The state dict
+    is ``{"counts": ...}`` for fixed-window banks and one named row
+    per kernel state array for algorithm banks (sliding-window's
+    window/curr/prev, GCRA's tat_sec/tat_frac — see
+    models/registry.py state_rows).  This is the only part that needs
+    exclusive access to the engine; serialization and disk I/O happen
+    afterwards on the caller's thread."""
+    return engine.export_state(), engine.slot_table.entries()
 
 
 def write_snapshot(
-    path: str, num_slots: int, counts, entries, role: str = ""
+    path: str,
+    num_slots: int,
+    state,
+    entries,
+    role: str = "",
+    algorithm: str = "fixed_window",
 ) -> None:
     """Serialize + atomically write a snapshot (no pickle: keys are
     stored as concatenated utf-8 bytes + a length array, so restore
     can run with allow_pickle=False on untrusted files).  `role` names
     the bank's position in the cache topology (e.g. "lane1of4",
-    "per_second") so a topology change can't silently restore one
-    bank's keys into a different-purpose engine whose slot count
-    happens to match."""
+    "per_second", "algo_gcra") so a topology change can't silently
+    restore one bank's keys into a different-purpose engine whose
+    slot count happens to match; `algorithm` likewise refuses to feed
+    one kernel's state rows to a different kernel.  ``state`` may be
+    a plain counts array (legacy callers) or the snapshot_engine
+    dict."""
+    if not isinstance(state, dict):
+        state = {"counts": state}
     key_bytes = [e[0].encode("utf-8") for e in entries]
     key_lens = np.array([len(b) for b in key_bytes], dtype=np.int64)
     key_blob = np.frombuffer(b"".join(key_bytes), dtype=np.uint8)
@@ -63,18 +77,25 @@ def write_snapshot(
             "version": FORMAT_VERSION,
             "num_slots": num_slots,
             "role": role,
+            "algorithm": algorithm,
+            "state_rows": sorted(state),
             "saved_at": time.time(),
         }
     )
+    arrays = {"state_" + name: arr for name, arr in state.items()}
+    if list(state) == ["counts"]:
+        # Fixed-window snapshots keep the historical layout so
+        # pre-algorithm checkpoints and new ones are interchangeable.
+        arrays = {"counts": state["counts"]}
     with open(tmp, "wb") as f:
         np.savez_compressed(
             f,
             meta=np.frombuffer(meta.encode(), dtype=np.uint8),
-            counts=counts,
             key_lens=key_lens,
             key_blob=key_blob,
             slots=slots,
             expiries=expiries,
+            **arrays,
         )
     os.replace(tmp, path)
 
@@ -83,8 +104,11 @@ def save_engine(engine, path: str, role: str = "") -> None:
     """snapshot_engine + write_snapshot in one call (tests, shutdown).
     Callers on the serving path should copy under exclusivity and
     write outside it — see CheckpointManager.checkpoint."""
-    counts, entries = snapshot_engine(engine)
-    write_snapshot(path, engine.model.num_slots, counts, entries, role)
+    state, entries = snapshot_engine(engine)
+    write_snapshot(
+        path, engine.model.num_slots, state, entries, role,
+        getattr(engine, "algorithm", "fixed_window"),
+    )
 
 
 def restore_engine(engine, path: str, role: str = "") -> bool:
@@ -119,7 +143,25 @@ def restore_engine(engine, path: str, role: str = "") -> bool:
                     engine.model.num_slots,
                 )
                 return False
-            counts = z["counts"]
+            saved_algo = meta.get("algorithm", "fixed_window")
+            engine_algo = getattr(engine, "algorithm", "fixed_window")
+            if saved_algo != engine_algo:
+                logger.warning(
+                    "checkpoint %s: algorithm %r != engine %r "
+                    "(kernel state is not interchangeable), skipping",
+                    path,
+                    saved_algo,
+                    engine_algo,
+                )
+                return False
+            if "counts" in z.files:
+                state = {"counts": z["counts"]}
+            else:
+                state = {
+                    name[len("state_"):]: z[name]
+                    for name in z.files
+                    if name.startswith("state_")
+                }
             blob = bytes(z["key_blob"])
             keys = []
             off = 0
@@ -133,9 +175,18 @@ def restore_engine(engine, path: str, role: str = "") -> bool:
         logger.warning("checkpoint %s unreadable (%s), starting fresh", path, e)
         return False
 
-    engine.import_counts(counts.astype(np.uint32))
+    engine.import_state({k: v.astype(np.uint32) for k, v in state.items()})
     table_cls = type(engine.slot_table)
-    engine.slot_table = table_cls.from_entries(engine.model.num_slots, entries)
+    if getattr(engine.slot_table, "refresh_expiry", False):
+        # Algorithm banks: preserve the refresh-on-touch lease policy
+        # across the restore (engine.py _refresh_table_cls).
+        engine.slot_table = table_cls.from_entries(
+            engine.model.num_slots, entries, refresh_expiry=True
+        )
+    else:
+        engine.slot_table = table_cls.from_entries(
+            engine.model.num_slots, entries
+        )
     logger.warning(
         "restored %d live keys from %s (saved %.0fs ago)",
         len(entries),
@@ -173,12 +224,16 @@ class CheckpointManager:
         engines = self.cache.engines()
         lanes = getattr(self.cache, "lanes", None)
         per_second = getattr(self.cache, "per_second_engine", None)
+        algo_banks = getattr(self.cache, "algorithm_banks", None) or {}
+        algo_by_id = {id(e): name for name, e in algo_banks.items()}
         roles = []
         for idx, e in enumerate(engines):
             if lanes is not None and idx < len(lanes) and e is lanes[idx]:
                 roles.append(f"lane{idx}of{len(lanes)}")
             elif per_second is not None and e is per_second:
                 roles.append("per_second")
+            elif id(e) in algo_by_id:
+                roles.append("algo_" + algo_by_id[id(e)])
             else:
                 roles.append(f"bank{idx}")
         return roles
@@ -206,15 +261,16 @@ class CheckpointManager:
             grabbed = {}
 
             def grab(e=engine, out=grabbed):
-                out["counts"], out["entries"] = snapshot_engine(e)
+                out["state"], out["entries"] = snapshot_engine(e)
 
             self.cache.run_exclusive(engine, grab)
             write_snapshot(
                 self._bank_path(idx),
                 engine.model.num_slots,
-                grabbed["counts"],
+                grabbed["state"],
                 grabbed["entries"],
                 roles[idx],
+                getattr(engine, "algorithm", "fixed_window"),
             )
 
     def start(self) -> None:

@@ -48,7 +48,12 @@ class Lane:
 # single structured array so the collector concatenates ONE array per
 # item instead of five (np.concatenate cost is per-piece, and a 4096-
 # lane batch is ~1k pieces).  Layout is C-friendly: i64 at offset 0,
-# four u32s after — 24 bytes, naturally aligned.
+# u32s after — 32 bytes, naturally aligned.  `divider` (window length
+# in seconds) is consumed only by generic-algorithm engine banks
+# (models/registry.py); fixed-window lanes stamp 0.  `algo` is the
+# registry algo_id of the lane's algorithm — fixed-window lanes
+# stamp 0, and today it exists for checkpoint/debug symmetry (banks
+# are per-algorithm, so routing never reads it per lane).
 LANE_DTYPE = np.dtype(
     [
         ("expiry", "<i8"),
@@ -56,6 +61,8 @@ LANE_DTYPE = np.dtype(
         ("limits", "<u4"),
         ("len", "<u4"),  # utf-8 byte length of this lane's key
         ("shadow", "<u4"),  # 0/1
+        ("divider", "<u4"),  # window length in seconds (0 = unused)
+        ("algo", "<u4"),  # models/registry.py algo_id
     ]
 )
 
@@ -98,6 +105,8 @@ class LanePack:
                 lane.limit,
                 len(b),
                 1 if lane.shadow else 0,
+                0,  # divider: Lane is the fixed-window compat surface
+                0,  # algo: fixed_window
             )
         return LanePack(key_blob=b"".join(enc), meta=meta)
 

@@ -31,6 +31,10 @@ class HostBatch:
     limits: np.ndarray  # uint32
     fresh: np.ndarray  # bool
     shadow: np.ndarray  # bool
+    # Per-lane window length in seconds; only generic-algorithm models
+    # (models/registry.py) consume it.  None -> dividers of 1 reach the
+    # device (inert for warmup probes with hits=0).
+    dividers: Optional[np.ndarray] = None  # uint32
 
 
 @dataclass
@@ -64,6 +68,20 @@ def _pick_table_cls(native: Optional[bool]):
     return SlotTable
 
 
+def _refresh_table_cls():
+    """Slot table for stable-stem algorithms (sliding-window/GCRA):
+    the Python table with refresh-on-touch expiry, so a continuously
+    hot key's slot — and the window/TAT state it carries — survives
+    indefinitely instead of being reclaimed ``divider`` seconds after
+    FIRST sight.  (The native table has no refresh path; these banks
+    trade its fused assign for state longevity.)"""
+    import functools
+
+    from .slot_table import SlotTable
+
+    return functools.partial(SlotTable, refresh_expiry=True)
+
+
 @dataclass
 class _Dedup:
     """Host-side duplicate-slot aggregation for one device chunk.
@@ -81,6 +99,10 @@ class _Dedup:
     prefix: np.ndarray  # uint64[count] exclusive same-slot prefix, batch order
     fresh: np.ndarray  # bool[g] any lane fresh
     limit_max: np.ndarray  # uint32[g] max limit in group (saturation cap)
+    # uint32[g] group window length, or None.  Same slot = same key =
+    # same rule, so the per-group max is just the shared divider; only
+    # generic-algorithm models consume it (see _dedup_chunk).
+    divider_max: Optional[np.ndarray] = None
 
     def totals_u32(self) -> np.ndarray:
         """Group totals CLAMPED (not wrapped) into the saturating u32
@@ -95,6 +117,7 @@ def _dedup_chunk(
     hits: np.ndarray,
     limits: np.ndarray,
     fresh: np.ndarray,
+    dividers: Optional[np.ndarray] = None,
 ) -> _Dedup:
     uniq, inv = np.unique(slots, return_inverse=True)
     inv = inv.reshape(-1)
@@ -106,6 +129,10 @@ def _dedup_chunk(
     np.logical_or.at(fresh_g, inv, fresh)
     limit_max = np.zeros(g, dtype=np.uint32)
     np.maximum.at(limit_max, inv, limits)
+    divider_max = None
+    if dividers is not None:
+        divider_max = np.zeros(g, dtype=np.uint32)
+        np.maximum.at(divider_max, inv, dividers.astype(np.uint32))
     if g == len(slots):  # no duplicates: identity prefixes
         prefix = np.zeros(len(slots), dtype=np.uint64)
     else:
@@ -126,6 +153,7 @@ def _dedup_chunk(
         prefix=prefix,
         fresh=fresh_g,
         limit_max=limit_max,
+        divider_max=divider_max,
     )
 
 
@@ -282,27 +310,45 @@ class CounterEngine:
         native_table: Optional[bool] = None,
     ):
         """`model` defaults to a single-chip FixedWindowModel.  A
-        custom model must provide a SATURATING unique-slot serving
-        path (step_counters_unique_packed or step_counters_unique +
-        step_counters_unique_compact) — for mesh models use
-        parallel.ShardedCounterEngine, which overrides the device
-        submit with its routed path.  `native_table`: None = use the
-        C++ slot table when it builds/loads, True = require it,
-        False = pure Python."""
+        custom model must provide EITHER a SATURATING unique-slot
+        serving path (step_counters_unique_packed or
+        step_counters_unique + step_counters_unique_compact) OR the
+        generic algorithm-table protocol (models/registry.py):
+        ``step_serve_packed(state, packed, now)`` on device plus
+        ``lane_counts(out, dedup, hits, limits, now)`` on host — the
+        engine then dispatches through the generic path and runs the
+        shared threshold state machine (limiter.base.decide_batch).
+        For mesh models use parallel.ShardedCounterEngine, which
+        overrides the device submit with its routed path.
+        `native_table`: None = use the C++ slot table when it
+        builds/loads, True = require it, False = pure Python; generic
+        models with stable-stem keys (windowed_keys=False) always get
+        the Python table with refresh-on-touch expiry."""
         self.model = model if model is not None else FixedWindowModel(
             num_slots, near_ratio
         )
-        if type(self)._device_submit is CounterEngine._device_submit and not (
-            hasattr(self.model, "step_counters_unique_packed")
-            or hasattr(self.model, "step_counters_unique")
+        # Generic algorithm-table protocol marker: the model owns both
+        # the device step and the host lane reconstruction.
+        self._generic = hasattr(self.model, "lane_counts")
+        if (
+            not self._generic
+            and type(self)._device_submit is CounterEngine._device_submit
+            and not (
+                hasattr(self.model, "step_counters_unique_packed")
+                or hasattr(self.model, "step_counters_unique")
+            )
         ):
             raise TypeError(
                 "model must provide a saturating unique-slot serving "
-                "path (step_counters_unique[_packed]); the modular "
+                "path (step_counters_unique[_packed]) or the generic "
+                "step_serve_packed/lane_counts protocol; the modular "
                 "update() path is not safe for serving — for mesh "
                 "models use parallel.ShardedCounterEngine"
             )
-        self._table_cls = _pick_table_cls(native_table)
+        if self._generic and not getattr(self.model, "windowed_keys", True):
+            self._table_cls = _refresh_table_cls()
+        else:
+            self._table_cls = _pick_table_cls(native_table)
         self.slot_table = self._table_cls(self.model.num_slots)
         self.buckets = tuple(sorted(buckets))
         self.max_batch = self.buckets[-1]
@@ -350,11 +396,11 @@ class CounterEngine:
                 return b
         return self.max_batch
 
-    def step(self, batch: HostBatch) -> HostDecisions:
+    def step(self, batch: HostBatch, now: int = 0) -> HostDecisions:
         """Run one padded device step per <=max_batch chunk."""
-        return self.step_complete(self.step_submit(batch))
+        return self.step_complete(self.step_submit(batch, now))
 
-    def step_submit(self, batch: HostBatch):
+    def step_submit(self, batch: HostBatch, now: int = 0):
         """Launch the device work for `batch` WITHOUT waiting for the
         readback; returns an opaque token for step_complete.
 
@@ -365,7 +411,9 @@ class CounterEngine:
 
         This entry takes pre-assigned slots (warmup, tests, oracle
         comparisons); the serving path is `submit_packed`, which fuses
-        slot assignment + dedup into one native call.
+        slot assignment + dedup into one native call.  ``now`` is the
+        batch clock — only generic-algorithm models (whose kernels do
+        their own window/TAT math) consume it.
         """
         n = len(batch.slots)
         chunks = []
@@ -382,13 +430,16 @@ class CounterEngine:
                 batch.hits[start:end],
                 batch.limits[start:end],
                 batch.fresh[start:end],
+                None
+                if batch.dividers is None
+                else batch.dividers[start:end],
             )
-            afters_dev, reassemble = self._device_submit(dedup)
+            afters_dev, reassemble = self._device_submit(dedup, now)
             chunks.append((afters_dev, start, count, dedup, reassemble))
             self.stat_window_rollovers += int(np.count_nonzero(dedup.fresh))
         self.stat_live_keys = len(self.slot_table)
         self.stat_evictions = self.slot_table.evictions
-        return (batch.hits, batch.limits, batch.shadow, chunks)
+        return (batch.hits, batch.limits, batch.shadow, chunks, now)
 
     def submit_packed(self, now: int, key_blob, meta: np.ndarray):
         """Serving fast path: assign slots AND dedup in one native call
@@ -406,6 +457,12 @@ class CounterEngine:
         hits = np.ascontiguousarray(meta["hits"])
         limits = np.ascontiguousarray(meta["limits"])
         shadow = meta["shadow"].astype(bool)
+        # Generic models need per-lane window lengths on device; the
+        # fixed-window paths never read them (and the fused native
+        # assign below predates the field).
+        dividers = (
+            np.ascontiguousarray(meta["divider"]) if self._generic else None
+        )
         chunks = []
         table = self.slot_table
         fused = hasattr(table, "assign_dedup_packed")
@@ -469,6 +526,7 @@ class CounterEngine:
                         hits[start:end],
                         limits[start:end],
                         fresh[start:end],
+                        None if dividers is None else dividers[start:end],
                     )
                     dedups.append((start, count, dedup))
         finally:
@@ -476,18 +534,18 @@ class CounterEngine:
                 table.end_batch()
         # Phase 2 — launch the device step per chunk.
         for start, count, dedup in dedups:
-            afters_dev, reassemble = self._device_submit(dedup)
+            afters_dev, reassemble = self._device_submit(dedup, now)
             chunks.append((afters_dev, start, count, dedup, reassemble))
             self.stat_window_rollovers += int(np.count_nonzero(dedup.fresh))
         self.stat_live_keys = len(table)
         self.stat_evictions = table.evictions
-        return (hits, limits, shadow, chunks)
+        return (hits, limits, shadow, chunks, now)
 
     def step_complete(self, token) -> HostDecisions:
         """Block on the readback for a step_submit token and run the
         host threshold state machine.  Thread-agnostic (touches no
         engine state)."""
-        hits, limits, shadow, chunks = token
+        hits, limits, shadow, chunks, now = token
         if not chunks:
             empty = np.zeros(0, dtype=np.int32)
             return HostDecisions(*([empty] * 8), empty.astype(bool))
@@ -497,6 +555,18 @@ class CounterEngine:
             if reassemble is not None:
                 fetched = reassemble(np.asarray(fetched))
             end = start + count
+            if self._generic:
+                outs.append(
+                    self._decide_generic(
+                        np.asarray(fetched),
+                        hits[start:end],
+                        limits[start:end],
+                        shadow[start:end],
+                        dedup,
+                        now,
+                    )
+                )
+                continue
             outs.append(
                 _decide_host(
                     fetched,
@@ -516,7 +586,52 @@ class CounterEngine:
             )
         )
 
-    def _device_submit(self, dedup: _Dedup):
+    def _decide_generic(
+        self,
+        fetched: np.ndarray,
+        hits_u32: np.ndarray,
+        limits_u32: np.ndarray,
+        shadow: np.ndarray,
+        dedup: _Dedup,
+        now: int,
+    ) -> HostDecisions:
+        """Host half of the generic algorithm protocol: the model
+        rebuilds per-lane effective (before, after) counts from its
+        device readback, then the SHARED threshold state machine
+        (limiter.base.decide_batch) produces codes/stat deltas —
+        near-limit and partial-hit attribution are identical across
+        every algorithm by construction.  Generic algorithms never
+        feed the host over-limit cache (their capacity refills
+        continuously, so an OVER_LIMIT verdict is not valid for the
+        remainder of any window) — set_local_cache stays False."""
+        from ..limiter.base import decide_batch
+
+        befores, afters = self.model.lane_counts(
+            fetched, dedup, hits_u32, limits_u32, now
+        )
+        count = len(hits_u32)
+        d = decide_batch(
+            limits=limits_u32,
+            befores=befores,
+            afters=afters,
+            hits=hits_u32.astype(np.int64),
+            near_ratio=self.model.near_ratio,
+            shadow_mask=shadow,
+            local_cache_mask=np.zeros(count, dtype=bool),
+        )
+        return HostDecisions(
+            codes=d.codes,
+            limit_remaining=d.limit_remaining,
+            befores=befores,
+            afters=afters,
+            over_limit=d.over_limit,
+            near_limit=d.near_limit,
+            within_limit=d.within_limit,
+            shadow_mode=d.shadow_mode,
+            set_local_cache=np.zeros(count, dtype=bool),
+        )
+
+    def _device_submit(self, dedup: _Dedup, now: int = 0):
         """Launch the device step for one deduped chunk; returns
         (device afters handle, reassemble-fn or None).  `reassemble`,
         when set, maps the fetched device array to one (possibly
@@ -525,6 +640,35 @@ class CounterEngine:
         g = len(dedup.uniq_slots)
         padded = self._bucket(g)
         ns = self.model.num_slots
+
+        if self._generic:
+            # Generic algorithm path: ONE int32[5, padded] transfer —
+            # rows (slots, hits-bits, limits-bits, fresh,
+            # divider-bits) — plus the batch clock; the model owns
+            # state layout, kernel math and host reconstruction.
+            # Padding uses DISTINCT out-of-table slots with divider=1,
+            # limit=1, hits=0 so pad lanes are inert.
+            pk = np.empty((5, padded), dtype=np.int32)
+            pk[0, :g] = dedup.uniq_slots
+            pk[1, :g] = dedup.totals_u32().view(np.int32)
+            pk[2, :g] = dedup.limit_max.view(np.int32)
+            pk[3, :g] = dedup.fresh
+            if dedup.divider_max is not None:
+                pk[4, :g] = dedup.divider_max.view(np.int32)
+            else:
+                pk[4, :g] = 1
+            if padded > g:
+                pk[0, g:] = np.arange(ns, ns + (padded - g), dtype=np.int64)
+                pk[1, g:] = 0
+                pk[2, g:] = 1
+                pk[3, g:] = 0
+                pk[4, g:] = 1
+            self._counts, out_dev = self.model.step_serve_packed(
+                self._counts,
+                jax.numpy.asarray(pk),
+                jax.numpy.asarray(now, dtype=jax.numpy.int32),
+            )
+            return out_dev, None
         # Dtype choice uses the UNWRAPPED uint64 totals; totals past
         # u32 max are CLAMPED for the device (not wrapped), matching
         # the saturating counter arithmetic — the device stores u32
@@ -609,6 +753,44 @@ class CounterEngine:
         self.slot_table = self._table_cls(self.model.num_slots)
 
     # -- checkpoint surface (backends/checkpoint.py) --------------------
+
+    @property
+    def algorithm(self) -> str:
+        """The model's algorithm-table name (models/registry.py);
+        stamped into checkpoints so a restore can never feed one
+        kernel's state rows to a different kernel."""
+        return getattr(self.model, "algo", "fixed_window")
+
+    def export_state(self) -> dict:
+        """Named copy of the per-slot device state.  Fixed-window:
+        ``{"counts": uint32[num_slots]}``; generic models expose one
+        row per ``model.state_rows`` name."""
+        arr = np.asarray(jax.device_get(self._counts))
+        rows = getattr(self.model, "state_rows", None)
+        if rows is None or arr.ndim == 1:
+            return {"counts": arr.reshape(-1)}
+        return {name: arr[i].copy() for i, name in enumerate(rows)}
+
+    def import_state(self, state: dict) -> None:
+        """Inverse of export_state; validates names and shapes."""
+        rows = getattr(self.model, "state_rows", None)
+        if rows is None or rows == ("counts",):
+            self.import_counts(state["counts"])
+            return
+        ns = self.model.num_slots
+        stacked = np.empty((len(rows), ns), dtype=np.uint32)
+        for i, name in enumerate(rows):
+            arr = np.asarray(state[name], dtype=np.uint32).reshape(-1)
+            if arr.shape[0] != ns:
+                raise ValueError(
+                    f"state row {name!r} size {arr.shape[0]} != "
+                    f"num_slots {ns}"
+                )
+            stacked[i] = arr
+        put = jax.numpy.asarray(stacked)
+        if self._device is not None:
+            put = jax.device_put(put, self._device)
+        self._counts = put
 
     def export_counts(self) -> np.ndarray:
         """Flat uint32 copy of the counter table."""

@@ -24,8 +24,18 @@ from typing import Dict, List, Tuple
 
 
 class SlotTable:
-    def __init__(self, num_slots: int):
+    def __init__(self, num_slots: int, refresh_expiry: bool = False):
+        """``refresh_expiry=True`` extends a live key's expiry on every
+        assign (to the max of old and new): stable-stem algorithms
+        (sliding-window/GCRA, models/registry.py windowed_keys=False)
+        re-use ONE key across window rollovers and carry state the
+        slot must keep while the key stays hot — without refresh, a
+        continuously hot key would be reclaimed ``expiry - first
+        sight`` seconds in and its window/TAT state forgiven.
+        Fixed-window keys embed their window (a new window is a new
+        key), so the default stays append-only."""
         self.num_slots = int(num_slots)
+        self.refresh_expiry = bool(refresh_expiry)
         self._map: Dict[str, Tuple[int, int]] = {}  # key -> (slot, expiry)
         self._free: List[int] = list(range(self.num_slots - 1, -1, -1))
         self._heap: List[Tuple[int, str]] = []  # (expiry, key), lazy-deleted
@@ -49,6 +59,12 @@ class SlotTable:
             # alias two live keys inside one device step).
             if self._batch_active:
                 self._pinned.add(key)
+            if self.refresh_expiry and expiry > entry[1]:
+                # Touch extends the lease; the superseded heap entry
+                # lazy-deletes (gc/_evict_one skip entries whose expiry
+                # no longer matches the map).
+                self._map[key] = (entry[0], expiry)
+                heapq.heappush(self._heap, (expiry, key))
             return entry[0], False
 
         if not self._free:
@@ -96,10 +112,13 @@ class SlotTable:
 
     @classmethod
     def from_entries(
-        cls, num_slots: int, entries: List[Tuple[str, int, int]]
+        cls,
+        num_slots: int,
+        entries: List[Tuple[str, int, int]],
+        refresh_expiry: bool = False,
     ) -> "SlotTable":
         """Rebuild a table from checkpointed entries (restore path)."""
-        t = cls(num_slots)
+        t = cls(num_slots, refresh_expiry=refresh_expiry)
         used = set()
         for key, slot, expiry in entries:
             slot = int(slot)

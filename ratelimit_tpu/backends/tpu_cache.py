@@ -37,6 +37,7 @@ import numpy as np
 
 from ..api import Code, DescriptorStatus, RateLimitRequest
 from ..config import RateLimitRule
+from ..models.registry import ALGORITHMS
 from ..observability import HotKeySketch, TRACER
 from ..limiter.cache_key import CacheKeyGenerator, EMPTY_KEY
 from ..limiter.local_cache import LocalCache
@@ -59,6 +60,7 @@ from .engine import CounterEngine, HostDecisions
 
 # Device code -> api Code without an enum __call__ per lane.
 _CODE_BY_VALUE = {c.value: c for c in Code}
+_OVER_VALUE = int(Code.OVER_LIMIT)
 
 _CAT_NONE = 0  # no matching rule: OK, no stats
 _CAT_ENGINE = 1  # goes to the counter engine
@@ -83,6 +85,7 @@ class TpuRateLimitCache:
         unhealthy_after: int = 3,
         resolution_cache_entries: int = 1 << 16,
         hotkeys_top_k: int = 0,
+        algorithm_banks: Optional[dict] = None,
     ):
         """`engine` may be a LIST of engines: N independent host LANES,
         each with its own slot table, dispatcher thread pair, and
@@ -103,6 +106,36 @@ class TpuRateLimitCache:
         self.lanes: List[CounterEngine] = lanes
         self.engine = lanes[0]  # lane 0 (compat surface)
         self.per_second_engine = per_second_engine
+        # Algorithm-table banks (models/registry.py): one dedicated
+        # engine per non-default limiter algorithm (sliding-window,
+        # GCRA).  Rules carrying ``algorithm: <name>`` route their
+        # lanes here — as the CANDIDATE when ``shadow: true`` (the
+        # fixed-window lanes keep enforcing and decision divergence is
+        # counted below), as the ENFORCING bank otherwise.  Algorithms
+        # with no bank fold back to fixed-window at resolution time.
+        self.algorithm_banks: dict = {
+            name: eng
+            for name, eng in (algorithm_banks or {}).items()
+            if eng is not None
+        }
+        for name in self.algorithm_banks:
+            if name not in ALGORITHMS:
+                raise ValueError(f"unknown algorithm bank {name!r}")
+        self._algo_order = sorted(self.algorithm_banks)
+        n_base = len(lanes) + (1 if per_second_engine is not None else 0)
+        self._algo_bank_index = {
+            name: n_base + i for i, name in enumerate(self._algo_order)
+        }
+        # Tracer bank labels, by bank index (see _execute).
+        self._bank_labels = [f"lane{i}" for i in range(len(lanes))]
+        if per_second_engine is not None:
+            self._bank_labels.append("per_second")
+        self._bank_labels.extend("algo_" + n for n in self._algo_order)
+        # Shadow-rollout divergence tallies per algorithm:
+        # [agree, diverge] plain ints bumped on the RPC thread
+        # (stats-only GIL races accepted, like the resolver tallies);
+        # exported as ratelimit.tpu.shadow.<algo>.{agree,diverge}.
+        self._shadow_counts = {name: [0, 0] for name in self._algo_order}
         self.time_source = time_source or RealTimeSource()
         self.local_cache = local_cache
         self.key_generator = CacheKeyGenerator(cache_key_prefix)
@@ -117,6 +150,7 @@ class TpuRateLimitCache:
                 n_lanes=len(lanes),
                 lane_dtype=LANE_DTYPE,
                 capacity=resolution_cache_entries,
+                algorithms=frozenset(self.algorithm_banks),
             )
             if resolution_cache_entries > 0
             else None
@@ -169,6 +203,8 @@ class TpuRateLimitCache:
         self._inline_locks = {id(e): threading.Lock() for e in self.lanes}
         if per_second_engine is not None:
             self._inline_locks[id(per_second_engine)] = threading.Lock()
+        for eng in self.algorithm_banks.values():
+            self._inline_locks[id(eng)] = threading.Lock()
 
         self._dispatchers: dict = {}
         if batch_window_us > 0:
@@ -191,6 +227,16 @@ class TpuRateLimitCache:
                     batch_window_us,
                     batch_limit,
                     name="tpu-dispatcher-persecond",
+                    pipeline_depth=pipeline_depth,
+                    unhealthy_after=unhealthy_after,
+                )
+            for name in self._algo_order:
+                eng = self.algorithm_banks[name]
+                self._dispatchers[id(eng)] = BatchDispatcher(
+                    eng,
+                    batch_window_us,
+                    batch_limit,
+                    name="tpu-dispatcher-" + name,
                     pipeline_depth=pipeline_depth,
                     unhealthy_after=unhealthy_after,
                 )
@@ -301,6 +347,15 @@ class TpuRateLimitCache:
         keys: list = [EMPTY_KEY] * n
         categories = [_CAT_NONE] * n
         n_lanes = len(self.lanes)
+        # Algorithm-table routing state, allocated lazily: the common
+        # all-fixed-window request pays one int-truthiness branch per
+        # descriptor and nothing else.
+        algo_accs: Optional[dict] = None  # name -> (rows, enc, tpl)
+        shadow_accs: Optional[dict] = None  # name -> (rows, enc, tpl)
+        shadow_rows: Optional[list] = None  # (i, name, algo_id)
+        raw_over: Optional[list] = None  # enforced pre-shadow over-ness
+        cand_over: Optional[list] = None  # candidate over-ness
+        cand_code: Optional[list] = None  # candidate would-be code
         # Per-bank accumulators: (row indices, key bytes, record bytes),
         # lanes first, per-second bank last.  The single-bank common
         # case routes through bound appends with no bank indirection.
@@ -367,11 +422,13 @@ class TpuRateLimitCache:
             limits[i] = rule
             if fl_pending:
                 fl_pending = False
-                fl.note(
-                    rd.stem_hash,
-                    n_lanes if ps_bank is not None and rd.per_second
-                    else rd.lane,
-                )
+                if rd.algo_id and not rd.algo_shadow:
+                    note_bank = self._algo_bank_index[rd.algorithm]
+                elif ps_bank is not None and rd.per_second:
+                    note_bank = n_lanes
+                else:
+                    note_bank = rd.lane
+                fl.note(rd.stem_hash, note_bank)
             if hk is not None:
                 e = rd.hot
                 if e is None or e.key is None:
@@ -393,12 +450,45 @@ class TpuRateLimitCache:
             if ws is None or ws.window != now - now % rd.divider:
                 ws = rd.window_state(now)
             key = keys[i] = ws.cache_key
+            if rd.algo_id and not rd.algo_shadow:
+                # Rule ENFORCES a non-default algorithm: route to its
+                # dedicated bank.  The host over-limit cache is skipped
+                # — these kernels refill capacity continuously, so a
+                # full-window OVER_LIMIT verdict has no valid TTL.
+                categories[i] = _CAT_ENGINE
+                if algo_accs is None:
+                    algo_accs = {}
+                acc = algo_accs.get(rd.algorithm)
+                if acc is None:
+                    acc = algo_accs[rd.algorithm] = ([], [], [])
+                acc[0].append(i)
+                acc[1].append(ws.algo_key_bytes)
+                acc[2].append(ws.algo_template_bytes)
+                continue
             if local_cache is not None and local_cache.contains(key.key):
                 # Shadow rules skip the counter but never short-circuit
                 # to OVER_LIMIT (fixed_cache_impl.go:57-67).
                 categories[i] = _CAT_SKIP if rule.shadow_mode else _CAT_LOCAL
                 continue
             categories[i] = _CAT_ENGINE
+            if rd.algo_id:
+                # Shadow rollout: the candidate kernel evaluates the
+                # same descriptor on its own bank while fixed-window
+                # enforcement proceeds below; divergence is tallied
+                # after both complete (_note_shadow_outcomes).
+                if shadow_accs is None:
+                    shadow_accs = {}
+                    shadow_rows = []
+                    raw_over = [False] * n
+                    cand_over = [None] * n
+                    cand_code = [None] * n
+                sa = shadow_accs.get(rd.algorithm)
+                if sa is None:
+                    sa = shadow_accs[rd.algorithm] = ([], [], [])
+                sa[0].append(i)
+                sa[1].append(ws.algo_key_bytes)
+                sa[2].append(ws.algo_template_bytes)
+                shadow_rows.append((i, rd.algorithm, rd.algo_id))
             if single_bank:
                 add_row(i)
                 add_enc(ws.key_bytes)
@@ -445,7 +535,7 @@ class TpuRateLimitCache:
                         self.lanes[bank_idx],
                         self._make_packed_item(
                             rows, keys, limits, hits_addend, now, statuses,
-                            enc, tparts,
+                            enc, tparts, raw_over,
                         ),
                     )
                 )
@@ -457,13 +547,48 @@ class TpuRateLimitCache:
                     self.per_second_engine,
                     self._make_packed_item(
                         rows, keys, limits, hits_addend, now, statuses,
-                        enc, tparts,
+                        enc, tparts, raw_over,
                     ),
                 )
             )
+        if algo_accs is not None:
+            # Enforcing algorithm banks: normal items — statuses/stats
+            # assemble exactly like lane items, from the generic
+            # engine's decide.
+            for name, (rows, enc, tparts) in algo_accs.items():
+                items.append(
+                    (
+                        self._algo_bank_index[name],
+                        self.algorithm_banks[name],
+                        self._make_packed_item(
+                            rows, keys, limits, hits_addend, now, statuses,
+                            enc, tparts, raw_over,
+                        ),
+                    )
+                )
+        if shadow_accs is not None:
+            # Shadow candidates: side-channel items that record the
+            # candidate kernel's would-be outcome and touch NOTHING
+            # else (no statuses, no rule stats, no local cache).
+            for name, (rows, enc, tparts) in shadow_accs.items():
+                items.append(
+                    (
+                        self._algo_bank_index[name],
+                        self.algorithm_banks[name],
+                        self._make_candidate_item(
+                            rows, hits_addend, now, enc, tparts,
+                            cand_over, cand_code,
+                        ),
+                    )
+                )
+        shadow_info = (
+            (shadow_rows, raw_over, cand_over, cand_code)
+            if shadow_rows
+            else None
+        )
         return (
             items, statuses, categories, keys, limits, is_unlimited,
-            hits_addend, now, hot,
+            hits_addend, now, hot, shadow_info,
         )
 
     def _route_overrides(
@@ -525,6 +650,8 @@ class TpuRateLimitCache:
                 rule.limit.requests_per_unit,
                 len(b),
                 1 if rule.shadow_mode else 0,
+                0,  # divider: overrides always enforce fixed-window
+                0,  # algo: fixed_window
             )
             bank[0].append(i)
             bank[1].append(b)
@@ -561,6 +688,7 @@ class TpuRateLimitCache:
             hits_addend,
             now,
             hot,
+            shadow_info,
         ) = self._prepare_resolved(request, config)
         statuses = self._execute(
             limits, items, statuses, categories, hits_addend, now,
@@ -568,7 +696,36 @@ class TpuRateLimitCache:
         )
         if hot is not None:
             self._note_hotkey_outcomes(hot, statuses, limits, hits_addend)
+        if shadow_info is not None:
+            self._note_shadow_outcomes(*shadow_info)
         return statuses, limits, is_unlimited
+
+    def _note_shadow_outcomes(
+        self, shadow_rows, raw_over, cand_over, cand_code
+    ) -> None:
+        """Tally shadow-rollout divergence: for every shadowed
+        descriptor that reached the engines, compare the candidate
+        kernel's would-be over-ness against the enforced fixed-window
+        one (both PRE-shadow_mode, so a rule that also suppresses
+        OVER_LIMIT responses still measures real algorithm
+        divergence), bump the per-algorithm agree/diverge counters,
+        and deposit the first candidate's (code, algo) into the
+        flight-recorder note so the ring record carries BOTH codes."""
+        counts = self._shadow_counts
+        fl = self.flight
+        noted = fl is None
+        for i, name, algo_id in shadow_rows:
+            co = cand_over[i]
+            if co is None:
+                continue  # candidate never evaluated (shouldn't happen)
+            pair = counts[name]
+            if co == raw_over[i]:
+                pair[0] += 1
+            else:
+                pair[1] += 1
+            if not noted:
+                noted = True
+                fl.note_shadow(int(cand_code[i]), algo_id)
 
     def _note_hotkey_outcomes(
         self, hot, statuses, limits, hits_addend: int
@@ -617,11 +774,14 @@ class TpuRateLimitCache:
         # dispatcher threads via the WorkItem trace seam) and convert
         # the stamps to spans after wait() — see _record_item_spans.
         span = TRACER.current()
+        labels = self._bank_labels
         items: List[tuple] = []  # (engine, WorkItem)
         for bank, engine, item in prep_items:
             if span is not None:
                 item.trace = {
-                    "bank": "per_second" if bank == n_lanes else f"lane{bank}",
+                    "bank": (
+                        labels[bank] if bank < len(labels) else f"bank{bank}"
+                    ),
                     "submit": time.perf_counter(),
                 }
             items.append((engine, item))
@@ -790,6 +950,17 @@ class TpuRateLimitCache:
             )
         if self.hotkeys is not None:
             self.hotkeys.register_stats(store, scope + ".hotkeys")
+        # Shadow-rollout divergence family (docs/ALGORITHMS.md): one
+        # agree/diverge counter pair per configured algorithm bank —
+        # bounded by the algorithm table, not by traffic.
+        for name in self._algo_order:
+            pair = self._shadow_counts[name]
+            store.counter_fn(
+                scope + ".shadow." + name + ".agree", lambda p=pair: p[0]
+            )
+            store.counter_fn(
+                scope + ".shadow." + name + ".diverge", lambda p=pair: p[1]
+            )
         for idx, engine in enumerate(self.engines()):
             base = f"{scope}.bank{idx}"
             # Cached snapshots updated by the table-owning thread —
@@ -845,15 +1016,18 @@ class TpuRateLimitCache:
                 )
 
     def engines(self):
-        """All live counter banks, lanes first in lane order, then the
-        per-second bank (checkpoint surface; bank indices must be
-        stable across restarts — a changed TPU_NUM_LANES restores keys
-        into the wrong lane, where they age out via gc while their
-        counters restart, the same amnesia envelope as a cluster
-        membership change)."""
+        """All live counter banks: lanes first in lane order, then the
+        per-second bank, then the algorithm banks in sorted-name order
+        (checkpoint surface; bank indices must be stable across
+        restarts — a changed TPU_NUM_LANES restores keys into the
+        wrong lane, where they age out via gc while their counters
+        restart, the same amnesia envelope as a cluster membership
+        change; checkpoint roles additionally pin each algorithm
+        bank's name)."""
         out = list(self.lanes)
         if self.per_second_engine is not None:
             out.append(self.per_second_engine)
+        out.extend(self.algorithm_banks[n] for n in self._algo_order)
         return out
 
     def run_exclusive(self, engine, fn) -> None:
@@ -979,6 +1153,8 @@ class TpuRateLimitCache:
                 rule.limit.requests_per_unit,
                 len(b),
                 1 if rule.shadow_mode else 0,
+                0,  # divider: legacy path serves fixed-window only
+                0,  # algo: fixed_window
             )
         meta["hits"] = hits_clamped
         if jitters is not None:
@@ -998,6 +1174,7 @@ class TpuRateLimitCache:
         statuses: List[Optional[DescriptorStatus]],
         enc: List[bytes],
         tparts: List[bytes],
+        raw_over: Optional[list] = None,
     ) -> WorkItem:
         """Resolution-fast-path packer: the per-bank accumulators
         already hold the memoized key bytes and 24-byte template
@@ -1018,7 +1195,7 @@ class TpuRateLimitCache:
             meta["expiry"] += np.asarray(jitters, dtype=np.int64)
         pack = LanePack(key_blob=b"".join(enc), meta=meta, meta_u8=meta_u8)
         return self._finish_item(
-            rows, keys, limits, hits_addend, now, statuses, pack
+            rows, keys, limits, hits_addend, now, statuses, pack, raw_over
         )
 
     def _draw_jitters(self, rows) -> Optional[List[int]]:
@@ -1033,12 +1210,58 @@ class TpuRateLimitCache:
                 for _ in rows
             ]
 
+    def _make_candidate_item(
+        self,
+        rows: List[int],
+        hits_addend: int,
+        now: int,
+        enc: List[bytes],
+        tparts: List[bytes],
+        cand_over: list,
+        cand_code: list,
+    ) -> WorkItem:
+        """Shadow-candidate packer: same pre-serialized template join
+        as _make_packed_item, but the apply records ONLY the candidate
+        kernel's would-be outcome (pre-shadow_mode over-ness + code)
+        into the request-local side channel — no statuses, no rule
+        stats, no local cache, so a shadowed rule's enforced responses
+        stay byte-identical to plain fixed-window."""
+        buf = bytearray(b"".join(tparts))
+        meta = np.frombuffer(buf, dtype=LANE_DTYPE)
+        meta_u8 = np.frombuffer(buf, dtype=np.uint8)
+        hits_clamped = min(hits_addend, 0xFFFFFFFF)
+        if hits_clamped != 1:
+            meta["hits"] = hits_clamped
+        pack = LanePack(key_blob=b"".join(enc), meta=meta, meta_u8=meta_u8)
+        over_value = _OVER_VALUE
+
+        def apply(decisions: HostDecisions) -> None:
+            codes = decisions.codes.tolist()
+            shadow = decisions.shadow_mode.tolist()
+            for j, i in enumerate(rows):
+                c = int(codes[j])
+                cand_code[i] = c
+                cand_over[i] = c == over_value or shadow[j] > 0
+
+        pool = self._event_pool
+        event = pool.pop() if pool else threading.Event()
+        return WorkItem(
+            now=now,
+            lanes=(),
+            pack=pack,
+            apply=apply,
+            defer_apply=True,
+            event=event,
+        )
+
     def _finish_item(
-        self, rows, keys, limits, hits_addend, now, statuses, pack
+        self, rows, keys, limits, hits_addend, now, statuses, pack,
+        raw_over: Optional[list] = None,
     ) -> WorkItem:
         def apply(decisions: HostDecisions) -> None:
             self._apply_decisions(
-                rows, keys, limits, hits_addend, now, decisions, statuses
+                rows, keys, limits, hits_addend, now, decisions, statuses,
+                raw_over,
             )
 
         pool = self._event_pool
@@ -1064,6 +1287,7 @@ class TpuRateLimitCache:
         now: int,
         decisions: HostDecisions,
         statuses: List[Optional[DescriptorStatus]],
+        raw_over: Optional[list] = None,
     ) -> None:
         # One tolist() per field up front (on THIS thread — the RPC
         # waiter under defer_apply): per-lane reads below become plain
@@ -1082,6 +1306,10 @@ class TpuRateLimitCache:
         for j, i in enumerate(rows):
             rule = limits[i]
             stats = rule.stats
+            if raw_over is not None:
+                # Pre-shadow_mode over-ness, for the shadow-rollout
+                # divergence comparison (_note_shadow_outcomes).
+                raw_over[i] = codes[j] == _OVER_VALUE or shadow[j] > 0
             v = over[j]
             if v:
                 stats.over_limit.add(int(v))

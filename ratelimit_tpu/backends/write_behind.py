@@ -216,7 +216,7 @@ class WriteBehindRateLimitCache:
             enc.append(b)
             lane_keys.append(k)
             expiries.append(e)
-            meta[j] = (e, hits_addend, limits_arr[j], len(b), 0)
+            meta[j] = (e, hits_addend, limits_arr[j], len(b), 0, 0, 0)
 
         # Pass 2, under the lock: ONLY the decide basis + pending
         # update.  Duplicates inside the request see each other's hits

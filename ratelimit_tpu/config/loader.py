@@ -30,9 +30,12 @@ from typing import Dict, List, Optional, Sequence
 import yaml
 
 from ..api import Descriptor, RateLimit, Unit, UNIT_VALUES
+from ..models.registry import ALGORITHM_NAMES, DEFAULT_ALGORITHM
 from ..stats.manager import Manager, RateLimitStats
 
-# Whitelisted YAML keys (reference config_impl.go:49-59).
+# Whitelisted YAML keys (reference config_impl.go:49-59; `algorithm`
+# and `shadow` are the pluggable-limiter extension — see
+# docs/ALGORITHMS.md).
 VALID_KEYS = frozenset(
     {
         "domain",
@@ -44,6 +47,8 @@ VALID_KEYS = frozenset(
         "requests_per_unit",
         "unlimited",
         "shadow_mode",
+        "algorithm",
+        "shadow",
     }
 )
 
@@ -69,6 +74,14 @@ class RateLimitRule:
 
     Equivalent of reference config.RateLimit (config.go:19-25): the
     applied limit plus per-rule stats and unlimited/shadow flags.
+
+    ``algorithm`` selects the limiter kernel from the algorithm table
+    (models/registry.py); ``algo_shadow`` (YAML ``shadow: true``) runs
+    that kernel as a non-enforcing CANDIDATE — the rule keeps
+    enforcing fixed-window while decision divergence is counted on
+    /metrics and stamped into flight records (docs/ALGORITHMS.md).
+    Distinct from ``shadow_mode``, which suppresses OVER_LIMIT
+    responses of whatever algorithm enforces.
     """
 
     full_key: str
@@ -76,6 +89,8 @@ class RateLimitRule:
     stats: RateLimitStats
     unlimited: bool = False
     shadow_mode: bool = False
+    algorithm: str = DEFAULT_ALGORITHM
+    algo_shadow: bool = False
 
 
 class _Node:
@@ -231,12 +246,38 @@ class RateLimitConfig:
                     file, rl.get("requests_per_unit"), "requests_per_unit"
                 )
                 shadow_mode = _as_bool(file, desc.get("shadow_mode"), "shadow_mode")
+                # Pluggable limiter algorithm + shadow rollout flag
+                # (models/registry.py; docs/ALGORITHMS.md).
+                algorithm = _as_str(file, rl.get("algorithm"), "algorithm")
+                if algorithm == "":
+                    algorithm = DEFAULT_ALGORITHM
+                elif algorithm not in ALGORITHM_NAMES:
+                    raise _error(
+                        file,
+                        f"invalid rate limit algorithm '{algorithm}' "
+                        f"(known: {', '.join(sorted(ALGORITHM_NAMES))})",
+                    )
+                if unlimited and rl.get("algorithm") is not None:
+                    raise _error(
+                        file,
+                        "should not specify rate limit algorithm when unlimited",
+                    )
+                algo_shadow = _as_bool(file, rl.get("shadow"), "shadow")
+                if algo_shadow and algorithm == DEFAULT_ALGORITHM:
+                    raise _error(
+                        file,
+                        "shadow: true requires a non-default algorithm "
+                        "(shadow evaluates the candidate kernel while "
+                        f"'{DEFAULT_ALGORITHM}' keeps enforcing)",
+                    )
                 rule = RateLimitRule(
                     full_key=new_parent_key,
                     limit=RateLimit(requests_per_unit, Unit(unit_value)),
                     stats=self._stats_manager.rate_limit_stats(new_parent_key),
                     unlimited=unlimited,
                     shadow_mode=shadow_mode,
+                    algorithm=algorithm,
+                    algo_shadow=algo_shadow,
                 )
 
             child = _Node()
@@ -298,10 +339,15 @@ class RateLimitConfig:
         def walk(node: _Node) -> None:
             if node.rule is not None:
                 r = node.rule
+                algo = ""
+                if r.algorithm != DEFAULT_ALGORITHM:
+                    algo = f", algorithm: {r.algorithm}" + (
+                        " (shadow)" if r.algo_shadow else ""
+                    )
                 lines.append(
                     f"{r.full_key}: unit={r.limit.unit.name} "
                     f"requests_per_unit={r.limit.requests_per_unit}, "
-                    f"shadow_mode: {str(r.shadow_mode).lower()}\n"
+                    f"shadow_mode: {str(r.shadow_mode).lower()}{algo}\n"
                 )
             for child in node.children.values():
                 walk(child)

@@ -48,8 +48,29 @@ from zlib import crc32
 import numpy as np
 
 from ..api import Descriptor, Unit
+from ..models.registry import DEFAULT_ALGORITHM, get_algorithm
 from ..utils.time import unit_to_divider
 from .cache_key import CacheKey, build_stem
+
+_MISSING_BANK_WARNED: set = set()
+
+
+def _warn_missing_bank(algo: str) -> None:
+    """One log line per (process, algorithm): a rule asked for an
+    algorithm the backend has no engine bank for; it keeps limiting
+    with the default kernel instead."""
+    if algo in _MISSING_BANK_WARNED:
+        return
+    _MISSING_BANK_WARNED.add(algo)
+    import logging
+
+    logging.getLogger("ratelimit").warning(
+        "rule requests algorithm %r but the backend has no bank for "
+        "it; falling back to %s enforcement (enable the bank via "
+        "TPU_ALGORITHM_BANKS)",
+        algo,
+        DEFAULT_ALGORITHM,
+    )
 
 
 class WindowState:
@@ -62,6 +83,14 @@ class WindowState:
     array assignment) and reinterprets the blob as one LANE_DTYPE
     array.
 
+    For rules running a non-default algorithm in SHADOW mode the state
+    additionally carries the candidate bank's pack pieces
+    (``algo_key_bytes``/``algo_template_bytes``): the stable-stem key
+    and a template whose expiry leases the slot for two windows past
+    the current one (refresh-on-touch keeps it alive while hot).  An
+    ENFORCING algorithm rule needs no extra fields — its primary
+    key/template ARE the stable-stem ones.
+
     Immutable after construction; the owning entry swaps the whole
     object on window rollover so concurrent readers see either the old
     window's state or the new one, never a mix."""
@@ -72,6 +101,8 @@ class WindowState:
         "key_bytes",
         "template",
         "template_bytes",
+        "algo_key_bytes",
+        "algo_template_bytes",
         "_arr",
     )
 
@@ -82,12 +113,16 @@ class WindowState:
         key_bytes: bytes,
         template: Optional[np.void],
         arr: Optional[np.ndarray],
+        algo_key_bytes: bytes = b"",
+        algo_template_bytes: bytes = b"",
     ):
         self.window = window
         self.cache_key = cache_key
         self.key_bytes = key_bytes
         self.template = template
         self.template_bytes = arr.tobytes() if arr is not None else b""
+        self.algo_key_bytes = algo_key_bytes
+        self.algo_template_bytes = algo_template_bytes
         # The 1-element array backing `template` (np.void records are
         # views; keep the base alive explicitly).
         self._arr = arr
@@ -109,12 +144,23 @@ class ResolvedDescriptor:
         "lane",
         "unit",
         "divider",
+        "algorithm",
+        "algo_id",
+        "algo_shadow",
         "_lane_dtype",
         "_win",
         "hot",
     )
 
-    def __init__(self, generation: int, rule, stem: str, n_lanes: int, lane_dtype):
+    def __init__(
+        self,
+        generation: int,
+        rule,
+        stem: str,
+        n_lanes: int,
+        lane_dtype,
+        algorithms: frozenset = frozenset(),
+    ):
         self.generation = generation
         self.rule = rule
         self.unlimited = rule is not None and rule.unlimited
@@ -137,10 +183,31 @@ class ResolvedDescriptor:
             self.unit = rule.limit.unit
             self.divider = unit_to_divider(self.unit)
             self.per_second = self.unit == Unit.SECOND
+            # Algorithm-table routing (models/registry.py): resolved
+            # once per entry so the serving loop reads plain attrs.
+            # An algorithm the backend has NO bank for folds back to
+            # the default — the rule keeps limiting (fixed-window)
+            # instead of erroring every request it matches.
+            algo = getattr(rule, "algorithm", DEFAULT_ALGORITHM)
+            if algo != DEFAULT_ALGORITHM and algo not in algorithms:
+                _warn_missing_bank(algo)
+                algo = DEFAULT_ALGORITHM
+            self.algorithm = algo
+            self.algo_id = (
+                0
+                if algo == DEFAULT_ALGORITHM
+                else get_algorithm(algo).algo_id
+            )
+            self.algo_shadow = self.algo_id != 0 and bool(
+                getattr(rule, "algo_shadow", False)
+            )
         else:
             self.unit = None
             self.divider = 0
             self.per_second = False
+            self.algorithm = DEFAULT_ALGORITHM
+            self.algo_id = 0
+            self.algo_shadow = False
 
     def rehash_lanes(self, n_lanes: int) -> None:
         """Lane-count change (new cache topology): recompute the route
@@ -150,10 +217,35 @@ class ResolvedDescriptor:
         self.lane = self.stem_hash % n_lanes if n_lanes > 1 else 0
         self.n_lanes = n_lanes
 
+    def _algo_template_bytes(self, w: int) -> bytes:
+        """Lane record for this entry's non-default algorithm bank:
+        stable-stem key length, the rule's divider (the kernel's
+        window/emission math needs it), and an expiry leasing the slot
+        TWO windows past the current one — the algorithm banks'
+        refresh-on-touch slot tables extend it while the key stays
+        hot, so per-slot window/TAT state survives exactly as long as
+        it matters."""
+        rule = self.rule
+        arr = np.empty(1, dtype=self._lane_dtype)
+        arr[0] = (
+            w + 2 * self.divider,  # expiry lease (refreshed on touch)
+            1,  # hits pre-stamped to the common addend
+            rule.limit.requests_per_unit,
+            len(self.stem_bytes),
+            1 if rule.shadow_mode else 0,
+            self.divider,
+            self.algo_id,
+        )
+        return arr.tobytes()
+
     def window_state(self, now: int) -> WindowState:
         """The memoized per-window state, rebuilt once per rollover.
-        Byte-identical to CacheKeyGenerator output: key string is
-        ``stem + str(window_start)``."""
+        Byte-identical to CacheKeyGenerator output for fixed-window
+        rules: key string is ``stem + str(window_start)``.  Rules
+        ENFORCING a non-default algorithm key by the bare stem (their
+        kernels track windows per slot); rules SHADOWING one keep the
+        fixed-window primary and carry the candidate bank's pack
+        pieces alongside."""
         # Inline window_start(now, unit): the divider is resolved once
         # at entry construction, so the hot path skips the per-call
         # Unit coercion + divider lookup (measured ~1.5us/descriptor).
@@ -161,10 +253,31 @@ class ResolvedDescriptor:
         ws = self._win
         if ws is not None and ws.window == w:
             return ws
+        algo_enforced = self.algo_id != 0 and not self.algo_shadow
+        if algo_enforced:
+            # Stable-stem identity: one key across window rollovers,
+            # never routed to the per-second bank (algorithm banks are
+            # unit-agnostic — the divider rides the lane record).
+            ws = WindowState(
+                w,
+                CacheKey(self.stem, False, len(self.stem_bytes)),
+                self.stem_bytes,
+                None,
+                None,
+                algo_key_bytes=self.stem_bytes,
+                algo_template_bytes=(
+                    self._algo_template_bytes(w)
+                    if self._lane_dtype is not None
+                    else b""
+                ),
+            )
+            self._win = ws
+            return ws
         suffix = str(w)
         key_str = self.stem + suffix
         key_bytes = self.stem_bytes + suffix.encode("ascii")
         template = arr = None
+        algo_tpl = b""
         if self._lane_dtype is not None:
             rule = self.rule
             arr = np.empty(1, dtype=self._lane_dtype)
@@ -175,14 +288,20 @@ class ResolvedDescriptor:
                 rule.limit.requests_per_unit,
                 len(key_bytes),
                 1 if rule.shadow_mode else 0,
+                0,  # divider: fixed-window kernels never read it
+                0,  # algo: fixed_window
             )
             template = arr[0]
+            if self.algo_shadow:
+                algo_tpl = self._algo_template_bytes(w)
         ws = WindowState(
             w,
             CacheKey(key_str, self.per_second, len(self.stem_bytes)),
             key_bytes,
             template,
             arr,
+            algo_key_bytes=self.stem_bytes if self.algo_shadow else b"",
+            algo_template_bytes=algo_tpl,
         )
         self._win = ws  # single-slot swap: readers see old or new
         return ws
@@ -199,11 +318,16 @@ class ResolutionCache:
         n_lanes: int = 1,
         lane_dtype=None,
         capacity: int = 1 << 16,
+        algorithms: frozenset = frozenset(),
     ):
         self.prefix = prefix
         self.n_lanes = max(1, int(n_lanes))
         self.lane_dtype = lane_dtype
         self.capacity = int(capacity)
+        # Non-default algorithms the owning backend has banks for;
+        # rules asking for anything else fold to the default kernel
+        # (see ResolvedDescriptor).
+        self.algorithms = frozenset(algorithms)
         self._entries: dict = {}
         # Stats-only tallies; benign GIL races accepted (see module
         # docstring).  Exported as counters via register_stats on the
@@ -237,6 +361,7 @@ class ResolutionCache:
             build_stem(self.prefix, domain, descriptor.entries),
             self.n_lanes,
             self.lane_dtype if rule is not None and not rule.unlimited else None,
+            algorithms=self.algorithms,
         )
         if len(self._entries) >= self.capacity:
             # Same clear-on-full policy as the stem cache: a key-

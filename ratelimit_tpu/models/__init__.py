@@ -1,15 +1,55 @@
-from .fixed_window import (
-    DeviceBatch,
-    DeviceDecisions,
-    FixedWindowModel,
-    CODE_OK,
-    CODE_OVER_LIMIT,
+"""Limiter-algorithm models.
+
+The registry (``.registry``) is jax-free metadata; the model classes
+themselves import jax, so they resolve LAZILY here (PEP 562) — the
+config loader validates ``algorithm:`` names through this package
+without paying (or requiring) a device-stack import.
+"""
+
+from .registry import (
+    ALGO_FIXED_WINDOW,
+    ALGO_GCRA,
+    ALGO_SLIDING_WINDOW,
+    ALGORITHM_NAMES,
+    ALGORITHMS,
+    DEFAULT_ALGORITHM,
+    AlgorithmSpec,
+    get_algorithm,
 )
 
-__all__ = [
+_FIXED_WINDOW_NAMES = {
     "DeviceBatch",
     "DeviceDecisions",
     "FixedWindowModel",
     "CODE_OK",
     "CODE_OVER_LIMIT",
-]
+}
+
+__all__ = [
+    "ALGO_FIXED_WINDOW",
+    "ALGO_GCRA",
+    "ALGO_SLIDING_WINDOW",
+    "ALGORITHM_NAMES",
+    "ALGORITHMS",
+    "DEFAULT_ALGORITHM",
+    "AlgorithmSpec",
+    "get_algorithm",
+    "SlidingWindowModel",
+    "GcraModel",
+] + sorted(_FIXED_WINDOW_NAMES)
+
+
+def __getattr__(name: str):
+    if name in _FIXED_WINDOW_NAMES:
+        from . import fixed_window
+
+        return getattr(fixed_window, name)
+    if name == "SlidingWindowModel":
+        from .sliding_window import SlidingWindowModel
+
+        return SlidingWindowModel
+    if name == "GcraModel":
+        from .gcra import GcraModel
+
+        return GcraModel
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

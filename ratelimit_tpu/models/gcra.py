@@ -1,0 +1,215 @@
+"""GCRA (token bucket) rate limiting as a batched device kernel.
+
+The Generic Cell Rate Algorithm in its virtual-scheduling formulation:
+each slot stores one theoretical-arrival-time (TAT).  With emission
+interval ``T = divider / limit`` and burst tolerance
+``tau = divider - T`` (an idle key may burst exactly ``limit`` cells),
+a request of ``h`` cells at time ``now``:
+
+    conforms  iff  TAT <= now + tau
+    then           TAT' = max(TAT, now) + h * T
+
+This is the continuous-refill policy: capacity returns one cell per
+``T`` seconds instead of all at once at a window edge, so there is no
+boundary burst at all.
+
+Per-slot state is one 64-bit TAT stored as two uint32 rows —
+
+    row 0: tat_sec    unix seconds
+    row 1: tat_frac   fractional second in 2^-32 units
+
+— which keeps the kernel x32-clean (no jax_enable_x64, no f64 on
+TPU).  Device math runs in float32 on the RELATIVE value
+``TAT - now``, which the state ages into [0, ~divider] whenever the
+key is live, so f32 precision applies to a window-bounded quantity,
+not an absolute unix timestamp.  For limits where ``divider/limit``
+is f32-exact (every practical per-unit config) the arithmetic is
+exact; at extreme rates (limit ~1e9/unit) budget rounding is ~1 part
+in 2^24, biased toward stricter limiting.
+
+Batch semantics over duplicate lanes (the engine dedups same-key
+lanes to one slot): admission is cell-granular against the group's
+budget ``B = limit - ceil((TAT - now)+ / T)`` in pipeline order —
+lane ``k`` is admitted iff its exclusive hit-prefix plus its own
+``h`` fits in ``B``, and the device advances TAT by
+``min(total_h, B)`` cells.  For ``hits_addend == 1`` (the common
+case) this is exactly per-request GCRA; for multi-cell lanes
+straddling the budget the advance errs toward over-counting —
+the same safe direction as the fixed-window counter saturation.
+
+Serving protocol (backends/engine.py generic path): ``packed`` is
+int32[5, N] rows (slots, hits-bits, limits-bits, fresh,
+divider-bits) plus the batch clock; the kernel returns int32[N]
+per-group budgets.  The host maps budgets onto the shared threshold
+state machine by synthesizing ``before = limit - B + prefix`` (cells
+already consumed against the limit), so OVER/near-limit attribution
+and shadow_mode ride limiter.base.decide_batch unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import ALGO_GCRA
+
+_FRAC_UNIT = float(2.0**-32)
+_FRAC_SCALE = float(2.0**32)
+#: Largest float32 strictly below 2^32 — the frac-store clamp.
+_FRAC_MAX = float(np.nextafter(np.float32(_FRAC_SCALE), np.float32(0)))
+_B_MAX = float(2**31 - 128)  # i32-safe budget clamp (f32-representable)
+
+
+class GcraModel:
+    """Configuration + jittable step for the TAT table."""
+
+    algo = ALGO_GCRA
+    #: Stable-stem keys: the TAT must survive window rollovers (see
+    #: module docstring); the owning engine uses refresh-on-touch
+    #: expiry.
+    windowed_keys = False
+    state_rows = ("tat_sec", "tat_frac")
+
+    def __init__(self, num_slots: int, near_ratio: float = 0.8):
+        self.num_slots = int(num_slots)
+        self.near_ratio = float(near_ratio)
+
+    def init_state(self) -> jax.Array:
+        """Fresh state: every TAT at 0 (i.e. the distant past: any
+        key's first sighting has full burst capacity)."""
+        return jnp.zeros((2, self.num_slots), dtype=jnp.uint32)
+
+    @functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def step_serve_packed(
+        self, state: jax.Array, packed: jax.Array, now: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """One serving step over UNIQUE slots (the engine dedups).
+
+        Padding lanes use out-of-table slots (gathers fill 0, scatters
+        drop) with divider=1, limit=1, hits=0, so they are inert.
+        """
+        slots = packed[0]
+        hits = jax.lax.bitcast_convert_type(packed[1], jnp.uint32)
+        limits = jax.lax.bitcast_convert_type(packed[2], jnp.uint32)
+        fresh = packed[3] != 0
+        divider = jax.lax.bitcast_convert_type(packed[4], jnp.uint32)
+        now_u = now.astype(jnp.uint32)
+
+        sec = state[0].at[slots].get(mode="fill", fill_value=0)
+        frac = state[1].at[slots].get(mode="fill", fill_value=0)
+        sec = jnp.where(fresh, jnp.uint32(0), sec)
+        frac = jnp.where(fresh, jnp.uint32(0), frac)
+
+        # Signed relative seconds via two's-complement wraparound:
+        # |TAT - now| < 2^31 always (TAT <= now + divider + burst, and
+        # TAT=0 for fresh/idle keys gives -now, well inside i32).
+        rel = jax.lax.bitcast_convert_type(sec - now_u, jnp.int32)
+        d = rel.astype(jnp.float32) + frac.astype(jnp.float32) * jnp.float32(
+            _FRAC_UNIT
+        )
+        v = jnp.maximum(d, jnp.float32(0.0))  # (TAT - now)+, in seconds
+
+        limf = limits.astype(jnp.float32)
+        divf = divider.astype(jnp.float32)
+        t_emit = divf / limf  # inf when limit == 0 (rejects below)
+        tau = divf - t_emit
+        b_f = jnp.floor((tau - v) / t_emit) + jnp.float32(1.0)
+        b_f = jnp.where(limits > jnp.uint32(0), b_f, jnp.float32(0.0))
+        b_f = jnp.clip(b_f, jnp.float32(0.0), jnp.float32(_B_MAX))
+
+        adm = jnp.minimum(hits.astype(jnp.float32), b_f)  # cells admitted
+        upd = adm > jnp.float32(0.0)
+        # Mask T out of the no-update lanes so limit==0 (T=inf) can't
+        # turn 0-cell advances into NaNs.
+        new_d = v + adm * jnp.where(upd, t_emit, jnp.float32(0.0))
+        floor_d = jnp.floor(new_d)
+        new_sec = now_u + floor_d.astype(jnp.uint32)
+        new_frac = jnp.minimum(
+            (new_d - floor_d) * jnp.float32(_FRAC_SCALE),
+            jnp.float32(_FRAC_MAX),
+        ).astype(jnp.uint32)
+
+        sec_out = jnp.where(upd, new_sec, sec)
+        frac_out = jnp.where(upd, new_frac, frac)
+        state = state.at[:, slots].set(
+            jnp.stack([sec_out, frac_out]),
+            mode="drop",
+            unique_indices=True,
+        )
+        return state, b_f.astype(jnp.int32)
+
+    # -- host halves (backends/engine.py generic protocol) --------------
+
+    def lane_counts(
+        self,
+        out: np.ndarray,
+        dedup,
+        hits_u32: np.ndarray,
+        limits_u32: np.ndarray,
+        now: int,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Map per-group budgets onto the shared (before, after)
+        surface: ``before = limit - B + prefix`` is the cells already
+        consumed against the limit in pipeline order, so
+        ``after > limit  <=>  prefix + h > B`` — exactly the
+        conformance test.  ``before`` can go slightly negative when a
+        lane's limit sits below its group's max (mixed-limit groups
+        only); decide_batch's comparisons remain correct."""
+        g = len(dedup.uniq_slots)
+        budgets = np.asarray(out).reshape(-1)[:g].astype(np.int64)
+        befores = (
+            limits_u32.astype(np.int64)
+            - budgets[dedup.inv]
+            + dedup.prefix.astype(np.int64)
+        )
+        afters = befores + hits_u32.astype(np.int64)
+        return befores, afters
+
+    def reference_step(
+        self,
+        state: np.ndarray,
+        slots: np.ndarray,
+        hits: np.ndarray,
+        limits: np.ndarray,
+        fresh: np.ndarray,
+        divider: np.ndarray,
+        now: int,
+    ) -> np.ndarray:
+        """Numpy oracle of step_serve_packed over unique in-table
+        slots (tests/bench verification); mutates ``state`` in place
+        and returns the per-slot budgets.  Same f32 ops in the same
+        order as the kernel."""
+        now_u = np.uint32(now)
+        sec = state[0, slots].copy()
+        frac = state[1, slots].copy()
+        fresh = fresh.astype(bool)
+        sec[fresh] = 0
+        frac[fresh] = 0
+        rel = (sec - now_u).view(np.int32)
+        d = rel.astype(np.float32) + frac.astype(np.float32) * np.float32(
+            _FRAC_UNIT
+        )
+        v = np.maximum(d, np.float32(0.0))
+        limits = limits.astype(np.uint32)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            t_emit = divider.astype(np.float32) / limits.astype(np.float32)
+            tau = divider.astype(np.float32) - t_emit
+            b_f = np.floor((tau - v) / t_emit) + np.float32(1.0)
+        b_f = np.where(limits > 0, b_f, np.float32(0.0))
+        b_f = np.clip(b_f, np.float32(0.0), np.float32(_B_MAX))
+        adm = np.minimum(hits.astype(np.float32), b_f)
+        upd = adm > 0
+        new_d = v + adm * np.where(upd, t_emit, np.float32(0.0))
+        floor_d = np.floor(new_d)
+        new_sec = (now_u + floor_d.astype(np.uint32)).astype(np.uint32)
+        new_frac = np.minimum(
+            (new_d - floor_d) * np.float32(_FRAC_SCALE),
+            np.float32(_FRAC_MAX),
+        ).astype(np.uint32)
+        state[0, slots] = np.where(upd, new_sec, sec)
+        state[1, slots] = np.where(upd, new_frac, frac)
+        return b_f.astype(np.int32)

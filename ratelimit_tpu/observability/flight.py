@@ -60,6 +60,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..models.registry import ALGO_ID_TO_NAME as _ALGO_NAMES
 from ..stats.manager import Histogram
 from ..utils.time import MonotonicClock, REAL_MONOTONIC, RealMonotonicClock
 
@@ -75,6 +76,14 @@ FLIGHT_DTYPE = np.dtype(
         ("code", np.int64),  # api.Code value of the overall decision
         ("hits", np.int64),  # request hits_addend (clamped >= 1)
         ("lat_bucket", np.int64),  # index into LATENCY_BOUNDS_MS
+        # Shadow-mode algorithm rollout (docs/ALGORITHMS.md): when the
+        # request hit a rule shadowing a candidate limiter kernel,
+        # BOTH codes land in the record — `code` is the enforced
+        # (fixed-window) decision, `code2` the candidate's would-be
+        # code (-1 when no shadow evaluation ran) and `algo` the
+        # candidate's models/registry.py algo_id (0 otherwise).
+        ("code2", np.int64),
+        ("algo", np.int64),
     ]
 )
 
@@ -89,9 +98,12 @@ MAX_DOMAINS = 256
 
 class _Note(threading.local):
     """Per-thread (stem_hash, lane) deposit from the backend's request
-    assembly, consumed by the same thread's ``record()`` call."""
+    assembly, consumed by the same thread's ``record()`` call.
+    ``shadow`` carries the candidate-algorithm (code2, algo_id) pair
+    deposited after a shadow comparison (backends/tpu_cache.py)."""
 
     value: tuple = (0, -1)
+    shadow: tuple = (-1, 0)
 
 
 class FlightRecorder:
@@ -126,6 +138,13 @@ class FlightRecorder:
         pass); consumed by the next ``record()`` on this thread."""
         self._note.value = (stem_hash, lane)
 
+    def note_shadow(self, code2: int, algo_id: int) -> None:
+        """Deposit the shadow-candidate outcome for this thread's
+        in-flight request (the candidate kernel's would-be code and
+        its algorithm id — backends/tpu_cache.py deposits after the
+        divergence comparison); consumed by the next ``record()``."""
+        self._note.shadow = (code2, algo_id)
+
     def _make_record(self):
         """Build ``record`` as a closure over locals: every per-call
         ``self.`` lookup and the clock indirection is paid once here
@@ -149,6 +168,7 @@ class FlightRecorder:
             else clock.now_ns
         )
         no_note = (0, -1)
+        no_shadow = (-1, 0)
 
         def record(
             domain: str, code: int, hits_addend: int, latency_ms: float
@@ -158,6 +178,9 @@ class FlightRecorder:
             stem, lane = note.value
             if lane != -1:
                 note.value = no_note  # consume: no inheriting a note
+            code2, algo = note.shadow
+            if code2 != -1:
+                note.shadow = no_shadow  # consume
             dom = domain_ids.get(domain)
             if dom is None:
                 dom = intern(domain)
@@ -172,6 +195,8 @@ class FlightRecorder:
                 code,
                 hits_addend if hits_addend > 0 else 1,
                 bis(bounds, latency_ms),
+                code2,
+                algo,
             )
 
         return record
@@ -218,21 +243,28 @@ class FlightRecorder:
         bounds = self._bounds
         out = []
         for rec in live[::-1].tolist():
-            seq, ts_ns, dom, stem, lane, code, hits, bucket = rec
-            out.append(
-                {
-                    "seq": seq,
-                    "ts_ns": ts_ns,
-                    "domain": names[dom] if 0 <= dom < len(names) else "?",
-                    "stem_hash": f"{stem & 0xFFFFFFFF:08x}",
-                    "lane": lane,
-                    "code": code,
-                    "hits": hits,
-                    "latency_le_ms": (
-                        bounds[bucket] if bucket < len(bounds) else float("inf")
-                    ),
-                }
-            )
+            (
+                seq, ts_ns, dom, stem, lane, code, hits, bucket,
+                code2, algo,
+            ) = rec
+            d = {
+                "seq": seq,
+                "ts_ns": ts_ns,
+                "domain": names[dom] if 0 <= dom < len(names) else "?",
+                "stem_hash": f"{stem & 0xFFFFFFFF:08x}",
+                "lane": lane,
+                "code": code,
+                "hits": hits,
+                "latency_le_ms": (
+                    bounds[bucket] if bucket < len(bounds) else float("inf")
+                ),
+            }
+            if code2 != -1:
+                # Shadow-mode dual record: the candidate kernel's
+                # would-be code + its algorithm-table name.
+                d["shadow_code"] = code2
+                d["shadow_algorithm"] = _ALGO_NAMES.get(algo, str(algo))
+            out.append(d)
         return out
 
     def domain_names(self) -> List[str]:

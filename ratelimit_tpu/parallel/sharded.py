@@ -337,7 +337,9 @@ class ShardedCounterEngine(CounterEngine):
     (round-1 VERDICT weak #4: the replicated design did full-batch
     work on every chip)."""
 
-    def _device_submit(self, dedup):
+    def _device_submit(self, dedup, now: int = 0):
+        # `now` is the generic-algorithm batch clock; the sharded
+        # engine serves fixed-window only (see CounterEngine).
         m = self.model
         spb = m.slots_per_bank
         nb = m.num_banks

@@ -58,6 +58,33 @@ def _make_engine(s: Settings, sharded: bool, num_slots: int):
     )
 
 
+def make_algorithm_banks(s: Settings):
+    """Build the dedicated engine banks for the configured non-default
+    limiter algorithms (models/registry.py; docs/ALGORITHMS.md), or
+    None when TPU_ALGORITHM_BANKS is empty.  An unknown name fails
+    startup — a typo'd bank list should never silently serve without
+    the kernel it asked for."""
+    names = [p.strip() for p in s.tpu_algorithm_banks.split(",") if p.strip()]
+    if not names:
+        return None
+    from .backends.engine import CounterEngine
+    from .models.registry import DEFAULT_ALGORITHM, get_algorithm
+
+    banks = {}
+    for name in names:
+        spec = get_algorithm(name)  # raises KeyError on typos
+        if spec.name == DEFAULT_ALGORITHM:
+            continue  # the lanes ARE the fixed-window banks
+        banks[spec.name] = CounterEngine(
+            near_ratio=s.near_limit_ratio,
+            buckets=tuple(s.tpu_batch_buckets),
+            model=spec.make_model(
+                s.tpu_algorithm_num_slots, s.near_limit_ratio
+            ),
+        )
+    return banks or None
+
+
 def lane_slot_split(total_slots: int, n_lanes: int) -> list:
     """Per-lane slot counts summing to `total_slots`: base = floor
     division, with the remainder distributed one slot each to the
@@ -148,6 +175,7 @@ def create_limiter(s: Settings, stats_manager: Manager, local_cache, time_source
             unhealthy_after=s.tpu_unhealthy_after,
             resolution_cache_entries=s.resolution_cache_entries,
             hotkeys_top_k=s.hotkeys_top_k,
+            algorithm_banks=make_algorithm_banks(s),
         )
     raise ValueError(f"Invalid setting for BackendType: {s.backend_type}")
 

@@ -212,6 +212,18 @@ class Settings:
     # disabled.
     tpu_compile_cache_dir: str = ""
 
+    # Pluggable limiter-algorithm banks (models/registry.py;
+    # docs/ALGORITHMS.md): comma list of non-default algorithms to
+    # build dedicated engine banks for.  Rules carrying `algorithm:
+    # <name>` route here (as candidate under `shadow: true`, as the
+    # enforcing bank otherwise); rules naming an algorithm with no
+    # bank fall back to fixed-window enforcement with a logged
+    # warning.  "" disables all algorithm banks.  Banks are
+    # single-chip engines even under tpu-sharded (per-slot state is
+    # small: 12 B/slot sliding-window, 8 B/slot GCRA).
+    tpu_algorithm_banks: str = "sliding_window,gcra"
+    tpu_algorithm_num_slots: int = 1 << 18
+
     # Hot-key tracking (observability/hotkeys.py): capacity of the
     # Space-Saving top-K sketch over descriptor stems, exposed as
     # GET /debug/hotkeys + the bounded ratelimit.tpu.hotkeys.* metric
@@ -341,6 +353,10 @@ def new_settings() -> Settings:
         tpu_checkpoint_dir=_env_str("TPU_CHECKPOINT_DIR", ""),
         tpu_checkpoint_interval_s=_env_float("TPU_CHECKPOINT_INTERVAL_S", 30.0),
         tpu_compile_cache_dir=_env_str("TPU_COMPILE_CACHE_DIR", ""),
+        tpu_algorithm_banks=_env_str(
+            "TPU_ALGORITHM_BANKS", "sliding_window,gcra"
+        ),
+        tpu_algorithm_num_slots=_env_int("TPU_ALGORITHM_NUM_SLOTS", 1 << 18),
         hotkeys_top_k=_env_int("HOTKEYS_TOP_K", 128),
         debug_profiling=_env_bool("DEBUG_PROFILING", False),
         flight_recorder_size=_env_int("FLIGHT_RECORDER_SIZE", 4096),

@@ -15,6 +15,12 @@ full gRPC stack, then asserts:
   blocked listener would fail here, not in production;
 - GET /debug/tracez shows the request's trace (the inbound traceparent
   id) with the kernel-phase span;
+- the shadow-mode algorithm rollout (docs/ALGORITHMS.md): a rule
+  running `algorithm: sliding_window, shadow: true` enforces
+  fixed-window unchanged while the candidate kernel evaluates the
+  same traffic — every decision lands in the per-algorithm
+  ratelimit.tpu.shadow.* divergence counters on /metrics and the
+  flight ring records carry BOTH codes;
 - the synthetic-anomaly scenario: injected latency + a forced
   OVER_LIMIT burst trip the EWMA detectors on a deterministic
   detectors.tick(), a bounded incident JSON (with a non-empty flight-
@@ -49,6 +55,12 @@ descriptors:
     rate_limit:
       unit: minute
       requests_per_unit: 2
+  - key: shadowed
+    rate_limit:
+      unit: minute
+      requests_per_unit: 3
+      algorithm: sliding_window
+      shadow: true
 """
 
 
@@ -214,6 +226,75 @@ def main() -> int:
             assert trace_id in tracez, tracez
             for span in ("decode", "service.should_rate_limit", "kernel.step"):
                 assert span in tracez, (span, tracez)
+
+            # --- shadow-mode algorithm rollout ------------------------
+            # One rule runs `algorithm: sliding_window, shadow: true`:
+            # fixed-window keeps enforcing while the candidate kernel
+            # evaluates the same traffic on its own bank.  Drive it
+            # past its tiny limit and assert (a) the per-algorithm
+            # divergence counter family is on /metrics with every
+            # decision tallied, and (b) the flight ring records carry
+            # BOTH codes (enforced + candidate) end-to-end through the
+            # real gRPC stamp path.
+            def shadow_request(value: str) -> "rls_pb2.RateLimitRequest":
+                req = rls_pb2.RateLimitRequest(domain="smoke")
+                d = req.descriptors.add()
+                e = d.entries.add()
+                e.key, e.value = "shadowed", value
+                return req
+
+            with grpc.insecure_channel(
+                f"127.0.0.1:{runner.grpc_server.bound_port}"
+            ) as channel:
+                method = channel.unary_unary(
+                    "/envoy.service.ratelimit.v3.RateLimitService/"
+                    "ShouldRateLimit",
+                    request_serializer=(
+                        rls_pb2.RateLimitRequest.SerializeToString
+                    ),
+                    response_deserializer=rls_pb2.RateLimitResponse.FromString,
+                )
+                shadow_codes = [
+                    method(shadow_request("s"), timeout=60).overall_code
+                    for _ in range(6)
+                ]
+            # Enforcement stays fixed-window: 3 admitted, 3 rejected.
+            assert (
+                shadow_codes.count(rls_pb2.RateLimitResponse.OVER_LIMIT) == 3
+            ), shadow_codes
+            metrics = get("/metrics")
+            shadow_vals = {}
+            for family in (
+                "ratelimit_tpu_shadow_sliding_window_agree",
+                "ratelimit_tpu_shadow_sliding_window_diverge",
+                "ratelimit_tpu_shadow_gcra_agree",
+                "ratelimit_tpu_shadow_gcra_diverge",
+            ):
+                lines = [
+                    line
+                    for line in metrics.splitlines()
+                    if line.startswith(family + " ")
+                ]
+                assert lines, family
+                shadow_vals[family] = int(lines[0].rsplit(" ", 1)[1])
+            # Every shadowed decision was compared, exactly once.
+            assert (
+                shadow_vals["ratelimit_tpu_shadow_sliding_window_agree"]
+                + shadow_vals["ratelimit_tpu_shadow_sliding_window_diverge"]
+                == 6
+            ), shadow_vals
+            dual = [
+                rec
+                for rec in runner.flight.snapshot_dicts()
+                if "shadow_code" in rec
+            ]
+            assert len(dual) == 6, len(dual)
+            assert all(
+                rec["shadow_algorithm"] == "sliding_window" for rec in dual
+            ), dual[:2]
+            # Both codes present and plausible (OK=1 / OVER_LIMIT=2).
+            assert {rec["code"] for rec in dual} == {1, 2}, dual
+            assert all(rec["shadow_code"] in (1, 2) for rec in dual), dual
 
             # --- synthetic-anomaly scenario ---------------------------
             # Deterministic detector ticks: tick 1 primes the delta

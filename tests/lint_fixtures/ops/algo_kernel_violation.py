@@ -1,0 +1,17 @@
+"""Seeded violations shaped like the pluggable-algorithm kernels
+(models/sliding_window.py / models/gcra.py): a host sync inside the
+jitted scatter path and a bare-literal scatter update.  The lint
+regression in tests/test_lint_engine.py pins both — the real kernels
+must stay clean against exactly these rules."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_algo_step(state, slots, hits):
+    prev = state.at[slots].get(mode="fill", fill_value=0)
+    total = float(prev.sum())  # jax-host-sync: host cast on a tracer
+    after = prev + hits.astype(jnp.uint32)
+    state = state.at[slots].set(0, mode="drop")  # dtype-discipline
+    return state, after, total

@@ -69,6 +69,28 @@ def test_dtype_rule_fires_on_seeded_violations():
     assert lines_for(findings, "dtype-discipline") == [8, 9, 10]
 
 
+def test_algo_kernel_fixture_fires_both_kernel_rules():
+    """The algorithm-kernel-shaped fixture seeds exactly one host sync
+    inside the jitted scatter path and one bare-literal scatter — the
+    two failure modes the pluggable-limiter kernels must never grow."""
+    findings = lint(FIXTURES / "ops" / "algo_kernel_violation.py")
+    assert lines_for(findings, "jax-host-sync") == [14]
+    assert lines_for(findings, "dtype-discipline") == [16]
+
+
+def test_algorithm_kernels_are_clean():
+    """Regression for the pluggable-limiter kernels: every model in
+    the algorithm table (models/registry.py) passes dtype-discipline
+    and jax-host-sync with ZERO findings — no host sync inside the
+    scatter paths, no implicit dtype promotion."""
+    models = REPO_ROOT / "ratelimit_tpu" / "models"
+    for mod in ("fixed_window.py", "sliding_window.py", "gcra.py"):
+        findings = lint(
+            models / mod, rules=[JaxHostSyncRule(), DtypeDisciplineRule()]
+        )
+        assert findings == [], (mod, findings)
+
+
 def test_timing_rule_fires_on_seeded_violations():
     findings = lint(FIXTURES / "timing_violation.py")
     # direct-call subtraction, name-bound subtraction, wall clock as
