@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: all test lint bench bench-host protos native serve check_config smoke_client metrics-smoke docker_image e2e e2e-local ci clean
+.PHONY: all test lint sanitize bench bench-host protos native serve check_config smoke_client metrics-smoke docker_image e2e e2e-local ci clean
 
 # C++ hot-path library: slot table + decide kernel (auto-built on
 # first import too; this forces it).  Goes through the Python builder
@@ -21,11 +21,21 @@ all: test
 test:
 	$(PY) -m pytest tests/ -q
 
-# tpu-lint static analysis (jax-host-sync, lock-discipline,
-# env-discipline, dtype-discipline — docs/STATIC_ANALYSIS.md).
+# tpu-lint v2 static analysis: per-file rules (jax-host-sync,
+# lock-discipline, env-discipline, dtype-discipline, ...) plus the
+# whole-program passes (lock-order-cycle, blocking-under-lock,
+# shared-state, dtype-pack-contract — docs/STATIC_ANALYSIS.md).
 # Fails on any unsuppressed finding; pure stdlib, no jax needed.
 lint:
 	PY=$(PY) sh scripts/lint.sh
+
+# Tier-1 under the runtime lock/atomicity sanitizer: every
+# threading.Lock/RLock created by package code is wrapped to record
+# REAL acquisition orders; lock-order cycles or blocking calls while
+# holding a lock observed anywhere in the run fail the session
+# (analysis/sanitizer.py, docs/STATIC_ANALYSIS.md).
+sanitize:
+	TPU_SANITIZE=1 $(PY) -m pytest tests/ -q
 
 # Headline benchmark on the default JAX device (real chip under axon).
 bench:
@@ -84,7 +94,7 @@ e2e-local:
 # The full CI recipe (.github/workflows/ci.yaml runs exactly this):
 # native build, tests, offline config validation, black-box e2e,
 # bench smoke on the CPU platform.
-ci: lint native test check_config metrics-smoke bench-host e2e-local
+ci: lint native test sanitize check_config metrics-smoke bench-host e2e-local
 	$(CPU_ENV) PALLAS_AXON_POOL_IPS= $(PY) bench.py
 
 clean:

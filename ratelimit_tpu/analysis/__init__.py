@@ -20,6 +20,8 @@ from .engine import (  # noqa: F401
     FileContext,
     Finding,
     Rule,
+    analyze_paths,
     run_paths,
 )
-from .rules import DEFAULT_RULES  # noqa: F401
+from .project import ProjectIndex, ProjectRule  # noqa: F401
+from .rules import DEFAULT_PROJECT_RULES, DEFAULT_RULES  # noqa: F401

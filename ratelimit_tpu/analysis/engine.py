@@ -179,27 +179,15 @@ class AnalysisEngine:
             raise ValueError(f"duplicate rule ids: {ids}")
 
     def check_source(self, path: str, source: str) -> List[Finding]:
-        try:
-            tree = ast.parse(source, filename=path)
-        except SyntaxError as e:
-            return [
-                Finding(
-                    rule_id="parse-error",
-                    path=path,
-                    line=e.lineno or 1,
-                    col=e.offset or 0,
-                    message=f"could not parse: {e.msg}",
-                )
-            ]
-        per_line, whole_file = _extract_suppressions(source)
-        ctx = FileContext(
-            path=path,
-            source=source,
-            tree=tree,
-            line_suppressions=per_line,
-            file_suppressions=whole_file,
-        )
+        ctx = build_context(path, source)
+        if isinstance(ctx, Finding):
+            return [ctx]
+        return self.check_ctx(ctx)
 
+    def check_ctx(self, ctx: FileContext) -> List[Finding]:
+        """Run the file rules over a pre-parsed context (the whole-
+        program driver parses each file exactly once and shares the
+        tree with the ProjectIndex)."""
         for rule in self.rules:
             rule.begin_file(ctx)
 
@@ -218,7 +206,7 @@ class AnalysisEngine:
                 walk(child)
             parents.pop()
 
-        walk(tree)
+        walk(ctx.tree)
 
         findings: List[Finding] = []
         for rule in self.rules:
@@ -234,6 +222,28 @@ class AnalysisEngine:
     def check_file(self, path: str) -> List[Finding]:
         source = Path(path).read_text(encoding="utf-8")
         return self.check_source(str(path), source)
+
+
+def build_context(path: str, source: str):
+    """Parse one file into a FileContext, or a parse-error Finding."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return Finding(
+            rule_id="parse-error",
+            path=path,
+            line=e.lineno or 1,
+            col=e.offset or 0,
+            message=f"could not parse: {e.msg}",
+        )
+    per_line, whole_file = _extract_suppressions(source)
+    return FileContext(
+        path=path,
+        source=source,
+        tree=tree,
+        line_suppressions=per_line,
+        file_suppressions=whole_file,
+    )
 
 
 def iter_python_files(paths: Iterable[str]) -> List[str]:
@@ -253,31 +263,96 @@ def iter_python_files(paths: Iterable[str]) -> List[str]:
     return out
 
 
+def analyze_paths(
+    paths: Sequence[str],
+    rules: Optional[Sequence[Rule]] = None,
+    project_rules: Optional[Sequence] = None,
+) -> Tuple[List[Finding], int]:
+    """The v2 whole-program pass: parse every file ONCE, run the file
+    rules per context, build the ProjectIndex and run the project
+    rules over it, then apply suppressions uniformly.  Returns
+    (findings, files_checked); raises ValueError for empty path sets
+    (the CLI maps it to exit 2)."""
+    from .project import ProjectIndex, ProjectRule  # local: keep engine light
+    from .rules import DEFAULT_RULES, DEFAULT_PROJECT_RULES
+
+    files = iter_python_files(paths)
+    if not files:
+        raise ValueError(f"no python files under {list(paths)}")
+    engine = AnalysisEngine(rules if rules is not None else DEFAULT_RULES)
+    if project_rules is None:
+        project_rules = DEFAULT_PROJECT_RULES
+    pids = [r.id for r in project_rules]
+    if len(set(pids)) != len(pids):
+        raise ValueError(f"duplicate project rule ids: {pids}")
+
+    findings: List[Finding] = []
+    ctxs = []
+    for f in files:
+        source = Path(f).read_text(encoding="utf-8")
+        ctx = build_context(str(f), source)
+        if isinstance(ctx, Finding):
+            findings.append(ctx)
+            continue
+        ctxs.append(ctx)
+        findings.extend(engine.check_ctx(ctx))
+
+    if project_rules and ctxs:
+        index = ProjectIndex(ctxs)
+        ctx_by_path = index.ctx_by_path
+        for rule in project_rules:
+            assert isinstance(rule, ProjectRule)
+            for f in rule.check_project(index):
+                ctx = ctx_by_path.get(f.path)
+                if ctx is not None and ctx.is_suppressed(
+                    f.rule_id, f.line
+                ):
+                    continue
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
+    return findings, len(files)
+
+
 def run_paths(
     paths: Sequence[str],
     rules: Optional[Sequence[Rule]] = None,
     fmt: str = "text",
     out=None,
+    project_rules: Optional[Sequence] = None,
+    baseline: Optional[dict] = None,
+    fail_on_new: bool = False,
 ) -> int:
     """Lint `paths`; print findings in `fmt`; return the exit code
-    (0 = clean, 1 = findings, 2 = usage error)."""
-    from .rules import DEFAULT_RULES
+    (0 = clean, 1 = findings, 2 = usage error).
 
+    With ``fail_on_new`` a committed ``baseline`` (analysis/
+    baseline.py) filters KNOWN findings: the exit code and the report
+    reflect only findings absent from the baseline, so CI gates on
+    regressions while a pre-existing backlog burns down
+    (docs/STATIC_ANALYSIS.md)."""
     out = out or sys.stdout
-    files = iter_python_files(paths)
-    if not files:
-        print(f"tpu-lint: no python files under {list(paths)}", file=sys.stderr)
+    try:
+        findings, n_files = analyze_paths(
+            paths, rules=rules, project_rules=project_rules
+        )
+    except ValueError as e:
+        print(f"tpu-lint: {e}", file=sys.stderr)
         return 2
-    engine = AnalysisEngine(rules if rules is not None else DEFAULT_RULES)
-    findings: List[Finding] = []
-    for f in files:
-        findings.extend(engine.check_file(f))
+
+    known_count = 0
+    if fail_on_new:
+        from .baseline import new_findings
+
+        kept = new_findings(findings, baseline or {})
+        known_count = len(findings) - len(kept)
+        findings = kept
 
     if fmt == "json":
         json.dump(
             {
-                "files_checked": len(files),
+                "files_checked": n_files,
                 "count": len(findings),
+                "baselined": known_count,
                 "findings": [f.as_dict() for f in findings],
             },
             out,
@@ -287,8 +362,14 @@ def run_paths(
     else:
         for f in findings:
             print(f.text(), file=out)
+        suffix = (
+            f" ({known_count} known finding(s) suppressed by baseline)"
+            if known_count
+            else ""
+        )
         print(
-            f"tpu-lint: {len(findings)} finding(s) in {len(files)} file(s)",
+            f"tpu-lint: {len(findings)} finding(s) in {n_files} "
+            f"file(s){suffix}",
             file=out,
         )
     return 1 if findings else 0

@@ -830,3 +830,14 @@ def _make_default_rules() -> List[Rule]:
 
 # The CLI's (serial) rule pack; begin_file() resets per-file state.
 DEFAULT_RULES: Sequence[Rule] = _make_default_rules()
+
+
+def _make_default_project_rules():
+    """The whole-program rule pack (fresh instances, same contract)."""
+    from .concurrency import make_concurrency_rules
+    from .contracts import make_contract_rules
+
+    return make_concurrency_rules() + make_contract_rules()
+
+
+DEFAULT_PROJECT_RULES = _make_default_project_rules()

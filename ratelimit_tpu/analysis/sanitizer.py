@@ -1,0 +1,436 @@
+"""Runtime lock/atomicity sanitizer (``TPU_SANITIZE=1``).
+
+The static lock-order rule (analysis/concurrency.py) sees the orders
+the AST can prove; this module records the orders the program REALLY
+exhibits while the test suite runs — the lockdep idea, sized for
+Python:
+
+- ``install()`` patches the ``threading.Lock`` / ``threading.RLock``
+  factories to return tracking wrappers.  Each lock's IDENTITY is its
+  creation site (``file:line``), so every instance allocated at one
+  site shares ordering constraints — two Counter instances prove an
+  ordering fact about Counter._lock, exactly like lockdep classes.
+- every acquisition while other locks are held adds edges to a global
+  lock-order graph; an edge that closes a cycle is a REAL AB/BA
+  inversion two threads could deadlock on, reported with both edges'
+  acquisition sites.
+- blocking while holding a lock — ``time.sleep`` or an untimed
+  ``threading.Event.wait`` with any sanitized lock held — is reported
+  as a held-across-blocking-call violation (every thread contending
+  on that lock stalls behind the sleeper).
+
+Scope: only locks CREATED from files matching ``TPU_SANITIZE_SCOPE``
+(default: this package + tests) are wrapped; library-internal locks
+(grpc, jax) pass through untouched, so overhead and noise stay
+bounded.  Violations are collected (deduplicated, bounded) and the
+pytest hook in tests/conftest.py fails the session when any exist;
+``TPU_SANITIZE_RAISE=1`` raises at the violation point instead (unit
+tests of the sanitizer itself use this).
+
+Wired as ``make sanitize`` (tier-1 under the sanitizer) inside
+``make ci`` — docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+_MAX_VIOLATIONS = 100
+
+#: Filename fragments whose frames are "plumbing" when attributing a
+#: lock's creation site.
+_SKIP_FRAGMENTS = ("/threading.py", "/analysis/sanitizer.py")
+
+
+def _default_scope() -> Tuple[str, ...]:
+    raw = os.environ.get("TPU_SANITIZE_SCOPE", "")  # tpu-lint: disable=env-discipline -- sanitizer activates before Settings exists (conftest pre-import)
+    if raw:
+        return tuple(s for s in raw.split(",") if s)
+    return ("ratelimit_tpu", "tests")
+
+
+def _creation_site() -> Optional[str]:
+    """file:line of the first frame outside threading/sanitizer
+    plumbing, or None when the allocation is out of scope."""
+    f = sys._getframe(2)
+    while f is not None:
+        fname = f.f_code.co_filename.replace("\\", "/")
+        if not any(s in fname for s in _SKIP_FRAGMENTS):
+            break
+        f = f.f_back
+    if f is None:
+        return None
+    fname = f.f_code.co_filename.replace("\\", "/")
+    if not any(s in fname for s in _SANITIZER.scope):
+        return None
+    return f"{fname}:{f.f_lineno}"
+
+
+class Violation:
+    __slots__ = ("kind", "detail", "thread", "stack")
+
+    def __init__(self, kind: str, detail: str, stack: str):
+        self.kind = kind
+        self.detail = detail
+        self.thread = threading.current_thread().name
+        self.stack = stack
+
+    def as_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "detail": self.detail,
+            "thread": self.thread,
+            "stack": self.stack,
+        }
+
+    def text(self) -> str:
+        return (
+            f"[{self.kind}] {self.detail} (thread {self.thread})\n"
+            f"{self.stack}"
+        )
+
+
+class _ThreadState(threading.local):
+    def __init__(self):
+        self.held: List[str] = []  # lock keys, acquisition order
+        self.depths: Dict[int, int] = {}  # id(wrapper) -> reentry depth
+        self.allow_blocking = 0  # allow_blocking() nesting depth
+
+
+class LockSanitizer:
+    """Global state: the runtime lock-order graph + violations."""
+
+    def __init__(self):
+        self.scope = _default_scope()
+        self.raise_on_violation = False
+        # raw lock (never a wrapper): guards graph/violations
+        self._glock = threading.RLock()
+        self._graph: Dict[str, Set[str]] = {}
+        # (a, b) -> human description of where the edge was observed
+        self._edge_sites: Dict[Tuple[str, str], str] = {}
+        self._violations: List[Violation] = []
+        self._seen_sigs: Set[tuple] = set()
+        self.installed = False
+        self._orig: dict = {}
+
+    # -- violation sink ---------------------------------------------------
+
+    def _report(self, kind: str, detail: str, sig: tuple) -> None:
+        stack = "".join(
+            traceback.format_list(traceback.extract_stack(limit=8)[:-3])
+        )
+        with self._glock:
+            if sig in self._seen_sigs:
+                return
+            self._seen_sigs.add(sig)
+            if len(self._violations) < _MAX_VIOLATIONS:
+                self._violations.append(Violation(kind, detail, stack))
+        if self.raise_on_violation:
+            raise RuntimeError(f"TPU_SANITIZE: [{kind}] {detail}")
+
+    def violations(self) -> List[Violation]:
+        with self._glock:
+            return list(self._violations)
+
+    def clear(self) -> None:
+        with self._glock:
+            self._violations.clear()
+            self._seen_sigs.clear()
+            self._graph.clear()
+            self._edge_sites.clear()
+
+    def format_report(self) -> str:
+        v = self.violations()
+        if not v:
+            return "tpu-sanitize: no violations"
+        out = [f"tpu-sanitize: {len(v)} violation(s)"]
+        out.extend(x.text() for x in v)
+        return "\n".join(out)
+
+    # -- graph ------------------------------------------------------------
+
+    def _note_acquire(self, key: str, held: List[str]) -> None:
+        """Called AFTER a top-level acquire succeeds, with the held
+        list as it was before this acquisition."""
+        if not held:
+            return
+        site = _acquire_site()
+        with self._glock:
+            for outer in held:
+                if outer == key:
+                    continue  # same lock class: reentrancy, not order
+                edges = self._graph.setdefault(outer, set())
+                if key in edges:
+                    continue
+                edges.add(key)
+                self._edge_sites[(outer, key)] = site
+                cycle = self._find_path(key, outer)
+                if cycle is not None:
+                    legs = " -> ".join(cycle + [key])
+                    where = "; ".join(
+                        f"{a}->{b} at {self._edge_sites.get((a, b), '?')}"
+                        for a, b in zip(
+                            [key] + cycle, cycle + [key]
+                        )
+                        if (a, b) in self._edge_sites
+                    )
+                    self._report(
+                        "lock-order-cycle",
+                        f"acquiring {key} while holding {outer} closes "
+                        f"the cycle {legs} ({where or site})",
+                        ("cycle", tuple(sorted((outer, key)))),
+                    )
+
+    def _find_path(self, src: str, dst: str) -> Optional[List[str]]:
+        """Nodes on a path src ->* dst (exclusive of dst), or None."""
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            for nxt in self._graph.get(node, ()):
+                if nxt == dst:
+                    return path
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def _note_blocking(self, what: str) -> None:
+        if _TLS.allow_blocking:
+            return  # inside a justified allow_blocking() scope
+        held = _TLS.held
+        if held:
+            self._report(
+                "held-across-blocking-call",
+                f"{what} while holding {held[-1]} "
+                f"(all held: {', '.join(held)}) at {_acquire_site()}",
+                ("blocking", what, held[-1]),
+            )
+
+    # -- install / uninstall ----------------------------------------------
+
+    def install(self, raise_on_violation: bool = False) -> None:
+        if self.installed:
+            self.raise_on_violation = raise_on_violation
+            return
+        self.raise_on_violation = raise_on_violation
+        self._orig = {
+            "Lock": threading.Lock,
+            "RLock": threading.RLock,
+            "sleep": time.sleep,
+            "event_wait": threading.Event.wait,
+        }
+        threading.Lock = _make_lock_factory(self._orig["Lock"], False)
+        threading.RLock = _make_lock_factory(self._orig["RLock"], True)
+        time.sleep = _make_sleep(self._orig["sleep"])
+        threading.Event.wait = _make_event_wait(self._orig["event_wait"])
+        self.installed = True
+
+    def uninstall(self) -> None:
+        if not self.installed:
+            return
+        threading.Lock = self._orig["Lock"]
+        threading.RLock = self._orig["RLock"]
+        time.sleep = self._orig["sleep"]
+        threading.Event.wait = self._orig["event_wait"]
+        self.installed = False
+
+
+_SANITIZER = LockSanitizer()
+_TLS = _ThreadState()
+
+
+def _acquire_site() -> str:
+    f = sys._getframe(2)
+    while f is not None:
+        fname = f.f_code.co_filename.replace("\\", "/")
+        if not any(s in fname for s in _SKIP_FRAGMENTS):
+            return f"{fname}:{f.f_lineno}"
+        f = f.f_back
+    return "?"
+
+
+class _SanitizedLockBase:
+    """Tracking wrapper around a real lock.  Reentrancy-aware: only
+    the OUTERMOST acquire/release push/pop the held stack, so RLock
+    recursion never double-counts."""
+
+    __slots__ = ("_inner", "_key")
+
+    def __init__(self, inner, key: str):
+        self._inner = inner
+        self._key = key
+
+    # -- tracking helpers -------------------------------------------------
+
+    def _on_acquired(self) -> None:
+        me = id(self)
+        depth = _TLS.depths.get(me, 0) + 1
+        _TLS.depths[me] = depth
+        if depth == 1:
+            _SANITIZER._note_acquire(self._key, list(_TLS.held))
+            _TLS.held.append(self._key)
+
+    def _on_release(self) -> None:
+        me = id(self)
+        depth = _TLS.depths.get(me, 0) - 1
+        if depth <= 0:
+            _TLS.depths.pop(me, None)
+            # remove by identity from wherever it sits (not always top:
+            # code may release out of order)
+            for i in range(len(_TLS.held) - 1, -1, -1):
+                if _TLS.held[i] == self._key:
+                    del _TLS.held[i]
+                    break
+        else:
+            _TLS.depths[me] = depth
+
+    # -- lock protocol -----------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._on_acquired()
+        return ok
+
+    def release(self) -> None:
+        self._on_release()
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<sanitized {self._key} of {self._inner!r}>"
+
+
+class _SanitizedLock(_SanitizedLockBase):
+    __slots__ = ()
+
+
+class _SanitizedRLock(_SanitizedLockBase):
+    """RLock wrapper: also speaks Condition's private protocol so
+    ``threading.Condition()`` (whose default lock is ``RLock()`` and
+    therefore sanitized) keeps the held stack honest across wait()."""
+
+    __slots__ = ()
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        # cv.wait(): the lock is FULLY released regardless of depth.
+        me = id(self)
+        depth = _TLS.depths.pop(me, 0)
+        if depth > 0:
+            for i in range(len(_TLS.held) - 1, -1, -1):
+                if _TLS.held[i] == self._key:
+                    del _TLS.held[i]
+                    break
+        return (self._inner._release_save(), depth)
+
+    def _acquire_restore(self, state):
+        inner_state, depth = state
+        self._inner._acquire_restore(inner_state)
+        if depth > 0:
+            _TLS.depths[id(self)] = depth
+            _TLS.held.append(self._key)
+
+
+def _make_lock_factory(orig_factory, is_rlock: bool):
+    cls = _SanitizedRLock if is_rlock else _SanitizedLock
+
+    def factory():
+        inner = orig_factory()
+        if not _SANITIZER.installed:
+            return inner
+        key = _creation_site()
+        if key is None:
+            return inner  # out of scope: raw lock, zero overhead
+        return cls(inner, key)
+
+    factory.__name__ = "RLock" if is_rlock else "Lock"
+    return factory
+
+
+def _make_sleep(orig_sleep):
+    def sleep(seconds):
+        if _SANITIZER.installed:
+            _SANITIZER._note_blocking(f"time.sleep({seconds!r})")
+        return orig_sleep(seconds)
+
+    return sleep
+
+
+def _make_event_wait(orig_wait):
+    def wait(self, timeout=None):
+        if _SANITIZER.installed and timeout is None:
+            _SANITIZER._note_blocking("untimed Event.wait()")
+        return orig_wait(self, timeout)
+
+    return wait
+
+
+# ---------------------------------------------------------------------------
+# module-level API (what conftest / tests import)
+# ---------------------------------------------------------------------------
+
+
+def install(raise_on_violation: bool = False) -> LockSanitizer:
+    """Activate the sanitizer (idempotent); returns the global
+    instance for violations()/format_report()."""
+    _SANITIZER.install(raise_on_violation=raise_on_violation)
+    return _SANITIZER
+
+
+def uninstall() -> None:
+    _SANITIZER.uninstall()
+
+
+def get() -> LockSanitizer:
+    return _SANITIZER
+
+
+class _AllowBlocking:
+    """Context manager marking the CURRENT THREAD's blocking calls as
+    sanctioned — the runtime analog of a ``# tpu-lint: disable=...
+    -- why`` suppression, and like it the justification is part of
+    the call site.  Use it ONLY where holding the lock across the
+    block is the design and nothing ever blocks on that lock (e.g.
+    the debug profiler's one-capture-at-a-time gate, whose contenders
+    take ``acquire(blocking=False)`` and answer 409 instead of
+    waiting)."""
+
+    __slots__ = ("why",)
+
+    def __init__(self, why: str):
+        if not why:
+            raise ValueError("allow_blocking requires a justification")
+        self.why = why
+
+    def __enter__(self):
+        _TLS.allow_blocking += 1
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _TLS.allow_blocking -= 1
+
+
+def allow_blocking(why: str) -> _AllowBlocking:
+    return _AllowBlocking(why)
+
+
+def enabled_by_env() -> bool:
+    return os.environ.get("TPU_SANITIZE", "") not in ("", "0", "false")  # tpu-lint: disable=env-discipline -- sanitizer activates before Settings exists (conftest pre-import)

@@ -157,7 +157,10 @@ class WorkItem:
 
     def get_pack(self) -> LanePack:
         if self.pack is None:
-            self.pack = LanePack.from_lanes(self.lanes)
+            # Lazy conversion has ONE toucher: the serving path
+            # pre-builds pack on the RPC thread before submit; only
+            # the collector converts lanes-based (test/compat) items.
+            self.pack = LanePack.from_lanes(self.lanes)  # tpu-lint: disable=shared-state -- single lazy toucher (collector)
         return self.pack
 
     def fail(self, exc: BaseException) -> None:

@@ -436,9 +436,13 @@ class CounterEngine:
             )
             afters_dev, reassemble = self._device_submit(dedup, now)
             chunks.append((afters_dev, start, count, dedup, reassemble))
-            self.stat_window_rollovers += int(np.count_nonzero(dedup.fresh))
-        self.stat_live_keys = len(self.slot_table)
-        self.stat_evictions = self.slot_table.evictions
+            # Engine stats are plain ints on purpose: the engine has a
+            # single toucher (the dispatcher collector owns it; inline
+            # mode serializes via tpu_cache._inline_locks) and the
+            # scrape side reads them lock-free as gauges.
+            self.stat_window_rollovers += int(np.count_nonzero(dedup.fresh))  # tpu-lint: disable=shared-state -- collector-owned engine
+        self.stat_live_keys = len(self.slot_table)  # tpu-lint: disable=shared-state -- collector-owned engine
+        self.stat_evictions = self.slot_table.evictions  # tpu-lint: disable=shared-state -- collector-owned engine
         return (batch.hits, batch.limits, batch.shadow, chunks, now)
 
     def submit_packed(self, now: int, key_blob, meta: np.ndarray):

@@ -10,6 +10,7 @@ and as the behavioral oracle in randomized differential tests.
 from __future__ import annotations
 
 import random
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..api import Code, DescriptorStatus, RateLimitRequest
@@ -43,6 +44,14 @@ class MemoryRateLimitCache:
         self.expiration_jitter_max_seconds = int(expiration_jitter_max_seconds)
         self.jitter_rand = jitter_rand or random.Random()
         self._counters: Dict[str, Tuple[int, int]] = {}  # key -> (count, expiry)
+        # The window increment is a read-modify-write: two gRPC pool
+        # threads hitting the same key could both read count=N and
+        # both store N+hits, silently admitting traffic past the limit
+        # (found by tpu-lint's shared-state pass; the Go reference's
+        # local memcache path serializes the same way).  One lock per
+        # RMW — this backend is the exact host oracle, not the TPU
+        # hot path.
+        self._counters_lock = threading.Lock()
         self._gc_cursor = 0
 
     def do_limit(
@@ -92,9 +101,10 @@ class MemoryRateLimitCache:
             expiry = window_start(now, rule.limit.unit) + divider
             if self.expiration_jitter_max_seconds > 0:
                 expiry += self.jitter_rand.randrange(self.expiration_jitter_max_seconds)
-            count, _ = self._counters.get(key.key, (0, 0))
-            after = count + hits_addend
-            self._counters[key.key] = (after, expiry)
+            with self._counters_lock:
+                count, _ = self._counters.get(key.key, (0, 0))
+                after = count + hits_addend
+                self._counters[key.key] = (after, expiry)
 
             d = decide(
                 limit=rule.limit.requests_per_unit,
@@ -124,13 +134,16 @@ class MemoryRateLimitCache:
         pass
 
     def _maybe_gc(self, now: int, batch: int = 128) -> None:
-        """Incremental expiry sweep (Redis-style active expiration)."""
-        if not self._counters:
-            return
-        keys = list(self._counters.keys())
-        start = self._gc_cursor % len(keys)
-        for key in keys[start : start + batch]:
-            entry = self._counters.get(key)
-            if entry is not None and entry[1] <= now:
-                del self._counters[key]
-        self._gc_cursor = start + batch
+        """Incremental expiry sweep (Redis-style active expiration).
+        Under the counters lock: an unlocked delete racing a
+        concurrent RMW could resurrect an expired window mid-write."""
+        with self._counters_lock:
+            if not self._counters:
+                return
+            keys = list(self._counters.keys())
+            start = self._gc_cursor % len(keys)
+            for key in keys[start : start + batch]:
+                entry = self._counters.get(key)
+                if entry is not None and entry[1] <= now:
+                    del self._counters[key]
+            self._gc_cursor = start + batch

@@ -15,7 +15,12 @@ mapping.  Design (SURVEY.md section 7 "hard parts (a)"):
   live key is evicted (its slot is zeroed on reuse via the batch's
   ``fresh`` flag, so eviction merely forgives the remainder of that
   key's window -- the same failure mode as Redis maxmemory eviction).
+
+The table is SINGLE-TOUCHER by design: the dispatcher collector
+thread owns it (SURVEY.md section 2 — checkpoints route through
+run_on_thread instead of locking), so its state carries no locks.
 """
+# tpu-lint: disable-file=shared-state -- single toucher: the dispatcher collector owns the table; checkpoints route through run_on_thread
 
 from __future__ import annotations
 

@@ -194,6 +194,10 @@ class TpuRateLimitCache:
         # set() has a happens-before edge to the waiter and never
         # touches the event again); timed-out/failed items keep
         # theirs, so a late set() can't leak into a new item.
+        # Take via _pool_event() ONLY: `pool.pop() if pool else ...`
+        # raced — another RPC thread can drain the last entry between
+        # the truthiness check and the pop, raising IndexError on the
+        # hot path (found by tpu-lint's shared-state pass).
         self._event_pool: List[threading.Event] = []
 
         # Inline mode (batch_window_us=0) runs the engine step on the
@@ -825,7 +829,10 @@ class TpuRateLimitCache:
         if len(pool) < 1024:
             for _, item in items:
                 item.event.clear()
-                pool.append(item.event)
+                # Plain-list append/EAFP-pop are each one GIL-atomic
+                # op (no check-then-act; see _pool_event); the 1024
+                # bound is advisory — an overshoot wastes an Event.
+                pool.append(item.event)  # tpu-lint: disable=shared-state -- GIL-atomic list ops; pop is EAFP in _pool_event
         if span is not None:
             self._record_item_spans(span, items)
 
@@ -1164,6 +1171,18 @@ class TpuRateLimitCache:
             rows, keys, limits, hits_addend, now, statuses, pack
         )
 
+    def _pool_event(self) -> threading.Event:
+        """One recycled (or fresh) Event.  EAFP on purpose: the old
+        ``pool.pop() if pool else Event()`` raced — a concurrent RPC
+        thread could drain the last entry between the truthiness check
+        and the pop, turning a hot-path request into an IndexError
+        (tests/test_unique_fastpath.py pins the empty-looking-pool
+        case)."""
+        try:
+            return self._event_pool.pop()
+        except IndexError:
+            return threading.Event()
+
     def _make_packed_item(
         self,
         rows: List[int],
@@ -1243,8 +1262,7 @@ class TpuRateLimitCache:
                 cand_code[i] = c
                 cand_over[i] = c == over_value or shadow[j] > 0
 
-        pool = self._event_pool
-        event = pool.pop() if pool else threading.Event()
+        event = self._pool_event()
         return WorkItem(
             now=now,
             lanes=(),
@@ -1264,8 +1282,7 @@ class TpuRateLimitCache:
                 raw_over,
             )
 
-        pool = self._event_pool
-        event = pool.pop() if pool else threading.Event()
+        event = self._pool_event()
         # defer_apply: status assembly runs on THIS RPC thread inside
         # item.wait(), not on the dispatcher's completer — it was the
         # completer's largest serial leg (host_path.json).

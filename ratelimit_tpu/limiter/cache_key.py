@@ -97,8 +97,8 @@ class CacheKeyGenerator:
             if len(self._stems) >= self._stem_cap:
                 # Rare full reset beats per-entry LRU bookkeeping on
                 # the hot path; regeneration is just the uncached cost.
-                self._stems.clear()
-                self.clears += 1
+                self._stems.clear()  # tpu-lint: disable=shared-state -- idempotent interning cache; a racing clear only costs regeneration
+                self.clears += 1  # tpu-lint: disable=shared-state -- stats-only tally; a lost increment skews a debug counter, never a decision
             stem = build_stem(self.prefix, domain, descriptor.entries)
             # [stem, (last_window, last_CacheKey), stem_byte_len] —
             # the finished CacheKey is cached per window, so a hot

@@ -214,8 +214,8 @@ class ResolvedDescriptor:
         for the new modulus.  The amnesia envelope is the same as a
         restart with a changed TPU_NUM_LANES — old windows' counters
         age out in the old lane while the key counts afresh."""
-        self.lane = self.stem_hash % n_lanes if n_lanes > 1 else 0
-        self.n_lanes = n_lanes
+        self.lane = self.stem_hash % n_lanes if n_lanes > 1 else 0  # tpu-lint: disable=shared-state -- idempotent re-derivation: every racer computes the same value
+        self.n_lanes = n_lanes  # tpu-lint: disable=shared-state -- idempotent re-derivation (same n_lanes input)
 
     def _algo_template_bytes(self, w: int) -> bytes:
         """Lane record for this entry's non-default algorithm bank:
@@ -271,7 +271,7 @@ class ResolvedDescriptor:
                     else b""
                 ),
             )
-            self._win = ws
+            self._win = ws  # tpu-lint: disable=shared-state -- whole-object swap: readers see the old or the new WindowState, never a mix (class docstring)
             return ws
         suffix = str(w)
         key_str = self.stem + suffix

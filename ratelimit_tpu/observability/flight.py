@@ -123,9 +123,15 @@ class FlightRecorder:
         self._counter = itertools.count()
         self._note = _Note()
         self._bounds = LATENCY_BOUNDS_MS
-        # Domain interning: dict get/set are GIL-atomic; a racing
-        # double-intern assigns two ids and the loser's id just goes
-        # unused (ids only label records, nothing indexes by them).
+        # Domain interning: the hot path is one GIL-atomic dict get;
+        # MISSES intern under a lock.  The previous lock-free intern
+        # raced: two RPC threads interning DIFFERENT domains could
+        # interleave append and len(), leaving one id pointing at the
+        # other thread's name — every later record for that domain
+        # rendered under the wrong label (found by tpu-lint's
+        # shared-state pass; tests/test_flight_recorder.py pins the
+        # id<->name agreement under concurrent intern).
+        self._intern_lock = threading.Lock()
         self._domain_ids: dict = {"_other": 0}
         self._domain_names: List[str] = ["_other"]
         self.record = self._make_record()
@@ -202,13 +208,22 @@ class FlightRecorder:
         return record
 
     def _intern_domain(self, domain: str) -> int:
-        names = self._domain_names
-        if len(names) >= MAX_DOMAINS:
-            return 0
-        names.append(domain)
-        dom = len(names) - 1
-        self._domain_ids[domain] = dom
-        return dom
+        # Cold path only (first sight of a domain).  The lock keeps
+        # the list position and the id in agreement; without it two
+        # threads interning different domains can cross-attribute
+        # (append/len interleave).  Double-check inside: the loser of
+        # the outer dict-get race must adopt the winner's id.
+        with self._intern_lock:
+            dom = self._domain_ids.get(domain)
+            if dom is not None:
+                return dom
+            names = self._domain_names
+            if len(names) >= MAX_DOMAINS:
+                return 0
+            names.append(domain)
+            dom = len(names) - 1
+            self._domain_ids[domain] = dom
+            return dom
 
     # -- read surface -----------------------------------------------------
 

@@ -33,6 +33,7 @@ current span), which follows the handler thread without threading a
 span argument through service/limiter/backends signatures.
 """
 
+# tpu-lint: disable-file=shared-state -- spans/trace bufs are request-owned (contextvar-scoped, one thread); the shared rings mutate under _ring_lock
 from __future__ import annotations
 
 import contextvars

@@ -222,6 +222,13 @@ class Runner:
                 else "%(asctime)s %(levelname)s %(name)s %(message)s"
             ),
         )
+        # A sampler/dispatcher/write-behind thread dying from an
+        # uncaught exception must scream in the service log, not print
+        # to bare stderr and vanish (utils/threads.py; the test
+        # bootstrap stacks a recording hook on the same seam).
+        from .utils.threads import install_thread_excepthook
+
+        install_thread_excepthook()
 
         if s.tpu_compile_cache_dir:
             # Must land before the first jit compile (engine creation

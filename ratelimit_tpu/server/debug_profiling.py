@@ -35,6 +35,8 @@ from collections import Counter
 from typing import Optional
 from urllib.parse import parse_qs, urlsplit
 
+from ..analysis.sanitizer import allow_blocking
+
 
 def threadz_text() -> str:
     """All-thread stack dump (the goroutine dump analog)."""
@@ -161,9 +163,21 @@ def add_profiling_routes(
             h._reply(409, b"a capture is already running\n")
             return
         try:
-            h._reply(200, sample_cpu_profile(seconds, hz).encode())
+            # The gate is non-blocking by construction (contenders
+            # answer 409 above, nothing ever waits on trace_lock), so
+            # holding it across the timed capture is the design — the
+            # runtime sanitizer gets the same justification the static
+            # suppressions carry.
+            with allow_blocking(
+                "one-capture-at-a-time gate; contenders get 409"
+            ):
+                body = sample_cpu_profile(seconds, hz).encode()
         finally:
             trace_lock.release()
+        # Reply AFTER release: replying first let a client's next
+        # capture request race the handler thread to the lock and
+        # draw a spurious 409.
+        h._reply(200, body)
 
     def xla_trace(h) -> None:
         if not _gate(h):
@@ -179,9 +193,12 @@ def add_profiling_routes(
                 artifacts, f"xla_trace_{time.time_ns()}"
             )
             os.makedirs(trace_dir, exist_ok=True)
-            jax.profiler.start_trace(trace_dir)
-            time.sleep(seconds)
-            jax.profiler.stop_trace()
+            with allow_blocking(
+                "one-capture-at-a-time gate; contenders get 409"
+            ):
+                jax.profiler.start_trace(trace_dir)
+                time.sleep(seconds)
+                jax.profiler.stop_trace()
             files = []
             for root, _dirs, names in os.walk(trace_dir):
                 for name in names:
@@ -189,16 +206,16 @@ def add_profiling_routes(
                     files.append(
                         f"{os.path.getsize(p):>10} {os.path.relpath(p, trace_dir)}"
                     )
-            body = (
+            status, body = 200, (
                 f"trace written to {trace_dir}\n"
                 + "\n".join(sorted(files))
                 + "\nopen with: tensorboard --logdir <dir>  (or Perfetto)\n"
-            )
-            h._reply(200, body.encode())
+            ).encode()
         except Exception as e:
-            h._reply(500, f"trace capture failed: {e}\n".encode())
+            status, body = 500, f"trace capture failed: {e}\n".encode()
         finally:
             trace_lock.release()
+        h._reply(status, body)  # after release, like profile()
 
     def debug_index(h) -> None:
         h._reply(200, render_debug_index(server).encode())

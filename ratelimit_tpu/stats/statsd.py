@@ -125,7 +125,7 @@ class StatsdExporter:
                 lines.append(f"{c.name}:{delta}|c")
         for name, value in self.store.counter_fn_values().items():
             delta = value - self._fn_last.get(name, 0)
-            self._fn_last[name] = value
+            self._fn_last[name] = value  # tpu-lint: disable=shared-state -- one writer at a time: stop() joins the loop thread BEFORE its final flush
             if delta > 0:  # benign races can read a tally mid-step
                 lines.append(f"{name}:{delta}|c")
         for name, value in self.store.gauges().items():

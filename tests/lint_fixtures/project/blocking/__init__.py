@@ -1,0 +1,4 @@
+# blocking-under-lock TRUE POSITIVE (cross-module): Store.checkpoint
+# holds Store._state_lock while calling disk.persist, which sleeps.
+# The per-file lock-discipline rule cannot see it — the sleep lives in
+# another module, reached only through the call graph.

@@ -1,0 +1,13 @@
+import threading
+
+from .disk import persist
+
+
+class Store:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self.rows = []
+
+    def checkpoint(self):
+        with self._state_lock:
+            persist(self.rows)  # reaches time.sleep under the lock

@@ -1,0 +1,4 @@
+# blocking-under-lock TRUE NEGATIVES: (a) the blocking call happens
+# AFTER the lock is released (snapshot-then-persist), and (b) a
+# cv.wait() on the very lock held is the condition-variable idiom
+# (wait releases it), not a stall.

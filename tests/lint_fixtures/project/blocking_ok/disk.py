@@ -1,0 +1,7 @@
+import time
+
+
+def persist(rows):
+    snapshot = list(rows)
+    time.sleep(0.05)
+    return snapshot

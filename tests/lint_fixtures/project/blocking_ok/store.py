@@ -1,0 +1,19 @@
+import threading
+
+from .disk import persist
+
+
+class Store:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self._cv = threading.Condition()
+        self.rows = []
+
+    def checkpoint(self):
+        with self._state_lock:
+            snapshot = list(self.rows)
+        persist(snapshot)  # blocking work happens OUTSIDE the lock
+
+    def wait_for_rows(self):
+        with self._cv:
+            self._cv.wait()  # releases the very lock held: the cv idiom

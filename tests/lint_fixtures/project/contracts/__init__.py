@@ -1,0 +1,3 @@
+# dtype-pack-contract fixtures: a pack format that drifted from its
+# dtype, a misaligned layout, an f64 on the device path (ops/), and a
+# clean dtype+format pair that must stay quiet.

@@ -1,0 +1,14 @@
+import struct
+
+import numpy as np
+
+EVENT_DTYPE = np.dtype(
+    [
+        ("seq", "<i8"),
+        ("ts", "<i8"),
+        ("code", "<i8"),
+    ]
+)
+
+# format matches the dtype field-for-field: stays quiet
+EVENT_PACKER = struct.Struct("<%dq" % len(EVENT_DTYPE.names))

@@ -1,0 +1,10 @@
+import numpy as np
+
+# MISALIGNED: the i8 lands at offset 4; and the 12-byte itemsize
+# tears across 64-bit word boundaries in concatenated buffers.
+MISALIGNED_DTYPE = np.dtype(
+    [
+        ("flag", "<u4"),
+        ("ts", "<i8"),
+    ]
+)

@@ -1,0 +1,1 @@
+# device-path directory for the no-f64 check

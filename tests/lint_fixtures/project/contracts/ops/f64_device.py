@@ -1,0 +1,15 @@
+import numpy as np
+
+
+def widen(x):
+    return np.asarray(x, dtype="float64")  # f64 on the device path
+
+
+def accumulate(x):
+    acc = np.float64(0.0)  # f64 scalar on the device path
+    return acc + x
+
+
+def lanes(x):
+    # u32 lanes + f32 math: the kernel contract, stays quiet
+    return np.asarray(x, dtype="uint32").astype("float32")

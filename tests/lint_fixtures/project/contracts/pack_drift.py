@@ -1,0 +1,15 @@
+import struct
+
+import numpy as np
+
+RECORD_DTYPE = np.dtype(
+    [
+        ("ts", "<i8"),
+        ("count", "<u4"),
+        ("flags", "<u4"),
+    ]
+)
+
+# DRIFT: 'q' per field assumes all-int64 rows, but count/flags are
+# u32 — packed rows would be 24 bytes against a 16-byte dtype.
+ROW_PACKER = struct.Struct("<%dq" % len(RECORD_DTYPE.names))

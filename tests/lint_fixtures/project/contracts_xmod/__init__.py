@@ -1,0 +1,3 @@
+# dtype-pack-contract CROSS-MODULE case: decl.py declares the dtype,
+# writer.py imports it and derives a struct format that drifted — the
+# mismatch is only visible when both files are in one index.

@@ -1,0 +1,9 @@
+import numpy as np
+
+WIDE_DTYPE = np.dtype(
+    [
+        ("expiry", "<i8"),
+        ("hits", "<u4"),
+        ("limits", "<u4"),
+    ]
+)

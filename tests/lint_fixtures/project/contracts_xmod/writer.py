@@ -1,0 +1,7 @@
+import struct
+
+from .decl import WIDE_DTYPE
+
+# DRIFT (cross-module): all-q format against an i8+u4+u4 dtype
+# declared in decl.py.
+pack_row = struct.Struct("<%dq" % len(WIDE_DTYPE.names)).pack_into

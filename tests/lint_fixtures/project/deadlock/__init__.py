@@ -1,0 +1,4 @@
+# Cross-module lock-order cycle fixture (lock-order-cycle TRUE
+# POSITIVE): deadlock.a acquires A._a_lock then B._b_lock through a
+# call; deadlock.b acquires them in the opposite order.  Neither file
+# alone shows an inversion — only the whole-program pass sees it.

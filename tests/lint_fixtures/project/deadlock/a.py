@@ -1,0 +1,17 @@
+import threading
+
+from .b import B
+
+
+class A:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self.peer = B()
+
+    def step(self):
+        with self._a_lock:
+            self.peer.poke()  # acquires B._b_lock under A._a_lock
+
+    def poke_back(self):
+        with self._a_lock:
+            pass

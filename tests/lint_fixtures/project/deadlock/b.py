@@ -1,0 +1,15 @@
+import threading
+
+
+class B:
+    def __init__(self):
+        self._b_lock = threading.Lock()
+        self.owner = None  # an A, attached after construction
+
+    def poke(self):
+        with self._b_lock:
+            pass
+
+    def run_cycle(self):
+        with self._b_lock:
+            self.owner.poke_back()  # acquires A._a_lock under B._b_lock

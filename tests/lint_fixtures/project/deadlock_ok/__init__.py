@@ -1,0 +1,3 @@
+# lock-order-cycle TRUE NEGATIVE: the same two locks, but every path
+# acquires A._a_lock strictly before B._b_lock — a consistent global
+# order has no cycle.

@@ -1,0 +1,17 @@
+import threading
+
+from .b import B
+
+
+class A:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self.peer = B()
+
+    def step(self):
+        with self._a_lock:
+            self.peer.poke()
+
+    def drain(self):
+        with self._a_lock:
+            self.peer.poke()

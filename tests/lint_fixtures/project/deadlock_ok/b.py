@@ -1,0 +1,10 @@
+import threading
+
+
+class B:
+    def __init__(self):
+        self._b_lock = threading.Lock()
+
+    def poke(self):
+        with self._b_lock:
+            pass

@@ -1,0 +1,4 @@
+# shared-state TRUE POSITIVE (cross-module): Worker.backlog is
+# written by the worker's own loop THREAD (Thread target) and by
+# Service.handle reached from the main/RPC context in another module
+# — two concurrent contexts, no lock anywhere.

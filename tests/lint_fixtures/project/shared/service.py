@@ -1,0 +1,9 @@
+from .worker import Worker
+
+
+class Service:
+    def __init__(self):
+        self.worker = Worker()
+
+    def handle(self, n):
+        self.worker.bump(n)  # main-context write, no lock

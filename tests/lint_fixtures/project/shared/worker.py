@@ -1,0 +1,17 @@
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self.backlog = 0
+        self._t = threading.Thread(target=self._loop, daemon=True)
+
+    def begin(self):
+        self._t.start()
+
+    def _loop(self):
+        while True:
+            self.backlog = self.backlog - 1
+
+    def bump(self, n):
+        self.backlog = self.backlog + n

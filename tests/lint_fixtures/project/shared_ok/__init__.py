@@ -1,0 +1,3 @@
+# shared-state TRUE NEGATIVE: the same two-context shape, but every
+# write happens under Worker._state_lock (directly or inside a
+# helper only ever called with the lock held).

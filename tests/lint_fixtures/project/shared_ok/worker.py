@@ -1,0 +1,24 @@
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self.backlog = 0
+        self._t = threading.Thread(target=self._loop, daemon=True)
+
+    def begin(self):
+        self._t.start()
+
+    def _loop(self):
+        while True:
+            with self._state_lock:
+                self._push(-1)
+
+    def bump(self, n):
+        with self._state_lock:
+            self._push(n)
+
+    def _push(self, n):
+        # only ever called with the lock held: lock-dominated helper
+        self.backlog = self.backlog + n

@@ -1,0 +1,4 @@
+# shared-state SUPPRESSION HONORED: the same race shape as the
+# shared/ fixture, but the write carries a justified suppression —
+# the engine's line-suppression machinery applies to whole-program
+# findings exactly as it does to per-file ones.

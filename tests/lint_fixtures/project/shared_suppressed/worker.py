@@ -1,0 +1,17 @@
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self.beat = 0
+        self._t = threading.Thread(target=self._loop, daemon=True)
+
+    def begin(self):
+        self._t.start()
+
+    def _loop(self):
+        while True:
+            self.beat = self.beat + 1  # tpu-lint: disable=shared-state -- GIL-atomic heartbeat counter; staleness is harmless by design
+
+    def touch(self):
+        self.beat = 0  # tpu-lint: disable=shared-state -- GIL-atomic heartbeat counter; staleness is harmless by design

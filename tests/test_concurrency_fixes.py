@@ -1,0 +1,172 @@
+"""Regression tests for the races tpu-lint v2's shared-state pass
+surfaced in tree (ISSUE 7 satellite: fixes, not suppressions).
+
+Three real findings, each pinned here:
+
+- flight recorder domain interning: the lock-free intern could
+  interleave ``names.append`` and ``len(names)`` across two RPC
+  threads, leaving one domain id pointing at the other thread's name
+  (every later record for that domain rendered under the wrong
+  label).  Fixed with a cold-path intern lock + double-check
+  (observability/flight.py).
+- event-pool recycling: ``pool.pop() if pool else Event()`` raced —
+  another RPC thread can drain the last entry between the truthiness
+  check and the pop, raising IndexError on the hot path.  Fixed as
+  EAFP ``_pool_event()`` (backends/tpu_cache.py).
+- memory-cache window increment: the read-modify-write on
+  ``_counters`` could lose concurrent increments (two threads both
+  read N, both store N+hits), silently admitting traffic past the
+  limit.  Fixed with a per-RMW lock (backends/memory_cache.py).
+"""
+
+import threading
+import types
+
+import pytest
+
+from ratelimit_tpu.api import Descriptor, RateLimitRequest, Unit
+from ratelimit_tpu.backends import MemoryRateLimitCache, TpuRateLimitCache
+from ratelimit_tpu.observability.flight import FlightRecorder
+
+
+def _run_threads(n, fn):
+    """n threads through `fn(i)` behind a barrier; re-raise the first
+    worker exception in the test thread."""
+    barrier = threading.Barrier(n)
+    errors = []
+
+    def worker(i):
+        try:
+            barrier.wait()
+            fn(i)
+        except BaseException as e:  # noqa: BLE001 - reported below
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,)) for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+
+
+# -- flight recorder: domain intern id<->name agreement ----------------------
+
+
+def test_concurrent_domain_intern_ids_and_names_agree():
+    """8 threads interning the same 64 fresh domains: every id must
+    point at ITS OWN name (the pre-fix interleave cross-attributed),
+    and no name may be interned twice."""
+    rec = FlightRecorder(size=64)
+    domains = [f"svc-{i}" for i in range(64)]
+
+    _run_threads(8, lambda i: [rec._intern_domain(d) for d in domains])
+
+    names = rec.domain_names()
+    assert len(names) == len(set(names)), "a domain was interned twice"
+    for d in domains:
+        dom = rec._domain_ids[d]
+        assert names[dom] == d, (d, dom, names[dom])
+
+
+def test_intern_loser_adopts_winner_id():
+    """The double-check inside the lock: a second intern of the same
+    domain returns the existing id, never a fresh one."""
+    rec = FlightRecorder(size=8)
+    a = rec._intern_domain("dup")
+    b = rec._intern_domain("dup")
+    assert a == b
+    assert rec.domain_names().count("dup") == 1
+
+
+# -- event pool: EAFP pop under a racing drain -------------------------------
+
+
+def test_pool_event_empty_looking_pool_never_raises():
+    """8 threads draining a pool seeded with fewer events than
+    takers: the pre-fix check-then-pop raised IndexError when a peer
+    drained the last entry between the truthiness check and the pop;
+    the EAFP helper must always hand back an Event."""
+    stub = types.SimpleNamespace(
+        _event_pool=[threading.Event() for _ in range(3)]
+    )
+    got = []
+    lock = threading.Lock()
+
+    def taker(_i):
+        out = []
+        for _ in range(200):
+            ev = TpuRateLimitCache._pool_event(stub)
+            assert isinstance(ev, threading.Event)
+            out.append(ev)
+        with lock:
+            got.extend(out)
+
+    _run_threads(8, taker)
+    assert len(got) == 8 * 200
+    # Every hand-out is a distinct Event: a recycled entry goes to
+    # exactly one taker, never two.
+    assert len(set(map(id, got))) == len(got)
+
+
+def test_pool_event_recycles_before_allocating():
+    stub = types.SimpleNamespace(_event_pool=[threading.Event()])
+    seeded = stub._event_pool[0]
+    assert TpuRateLimitCache._pool_event(stub) is seeded
+    fresh = TpuRateLimitCache._pool_event(stub)
+    assert fresh is not seeded and isinstance(fresh, threading.Event)
+
+
+# -- memory cache: concurrent RMW loses no increments ------------------------
+
+
+def test_memory_cache_concurrent_increments_not_lost(
+    clock, stats_manager
+):
+    """8 threads x 200 requests on ONE key: the final window counter
+    must equal the exact hit total (the pre-fix unlocked RMW dropped
+    interleaved increments, admitting traffic past the limit)."""
+    from tests.test_backends import make_rule
+
+    mem = MemoryRateLimitCache(clock)
+    rule = make_rule(
+        stats_manager, key="domain.k_v", rpu=10_000_000, unit=Unit.HOUR
+    )
+    desc = Descriptor.of(("k", "v"))
+
+    def hammer(_i):
+        r = RateLimitRequest("domain", [desc], 1)
+        for _ in range(200):
+            mem.do_limit(r, [rule])
+
+    _run_threads(8, hammer)
+
+    [st] = mem.do_limit(RateLimitRequest("domain", [desc], 1), [rule])
+    # 1600 concurrent hits + this probe's own.
+    assert st.limit_remaining == 10_000_000 - (8 * 200 + 1)
+
+
+def test_memory_cache_gc_does_not_resurrect_under_write(clock, stats_manager):
+    """The expiry sweep shares the counters lock: a sweep racing the
+    RMW must never leave a half-written window.  Exercised by
+    interleaving expired-window traffic with the sweep trigger."""
+    from tests.test_backends import make_rule
+
+    mem = MemoryRateLimitCache(clock)
+    rule = make_rule(
+        stats_manager, key="domain.g_v", rpu=1000, unit=Unit.SECOND
+    )
+    desc = Descriptor.of(("g", "v"))
+
+    def churn(i):
+        r = RateLimitRequest("domain", [desc], 1)
+        for _ in range(100):
+            mem.do_limit(r, [rule])
+
+    _run_threads(4, churn)
+    clock.now += 5  # expire the window; next request sweeps
+    [st] = mem.do_limit(RateLimitRequest("domain", [desc], 1), [rule])
+    assert st.limit_remaining == 1000 - 1  # fresh window, exactly one hit

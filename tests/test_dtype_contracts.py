@@ -1,0 +1,147 @@
+"""Runtime twin of the ``dtype-pack-contract`` static rule (ISSUE 7
+satellite): assert the IMPORTED layout authorities agree with each
+other, so a drift that somehow slips past the static fold still fails
+tier-1.
+
+Three authorities must stay in lockstep (docs/STATIC_ANALYSIS.md):
+
+- ``FLIGHT_DTYPE`` (observability/flight.py) vs the recorder's
+  whole-row ``struct.pack_into`` format (``"<%dq" % len(names)``);
+- ``LANE_DTYPE`` (backends/dispatcher.py) vs the 32-byte C layout the
+  native library and the resolution fast path's ``bytes.join`` ->
+  ``np.frombuffer`` reassembly assume;
+- the static checker's own model of both declarations (the AST fold
+  in analysis/contracts.py) vs the live numpy objects — if the
+  parser's arithmetic ever drifts from numpy's, this is the test
+  that says so.
+"""
+
+import struct
+
+import numpy as np
+
+from ratelimit_tpu.analysis.contracts import parse_dtype_decls
+from ratelimit_tpu.analysis.engine import build_context
+from ratelimit_tpu.analysis.project import ModuleInfo, module_name_for
+from ratelimit_tpu.backends.dispatcher import LANE_DTYPE, LanePack, Lane
+from ratelimit_tpu.observability.flight import FLIGHT_DTYPE, FlightRecorder
+
+
+# -- FLIGHT_DTYPE vs the recorder's pack format ------------------------------
+
+
+def test_flight_dtype_is_all_int64_and_word_aligned():
+    for name in FLIGHT_DTYPE.names:
+        field_dtype, offset = FLIGHT_DTYPE.fields[name]
+        assert field_dtype == np.int64, name
+        assert offset % 8 == 0, name
+    assert FLIGHT_DTYPE.itemsize == 8 * len(FLIGHT_DTYPE.names)
+
+
+def test_flight_pack_format_matches_dtype():
+    """The exact format string flight.py builds must cover the row
+    byte-for-byte: same total size, one little-endian int64 per field
+    at the field's offset."""
+    fmt = "<%dq" % len(FLIGHT_DTYPE.names)
+    assert struct.calcsize(fmt) == FLIGHT_DTYPE.itemsize
+    # offsets: the i-th packed value lands at the i-th field's offset
+    for i, name in enumerate(FLIGHT_DTYPE.names):
+        assert FLIGHT_DTYPE.fields[name][1] == i * 8, name
+
+
+def test_flight_packed_row_reads_back_field_for_field():
+    """Stamp one record through the real writer and read the ring
+    back through the STRUCTURED view: every field round-trips."""
+    rec = FlightRecorder(size=4)
+    rec.note(stem_hash=0xABCD, lane=3)
+    rec.record(domain="d", code=2, hits_addend=7, latency_ms=12.0)
+    [row] = rec.snapshot()
+    assert row["seq"] == 1
+    assert row["stem"] == 0xABCD
+    assert row["lane"] == 3
+    assert row["code"] == 2
+    assert row["hits"] == 7
+
+
+# -- LANE_DTYPE vs the 32-byte C layout --------------------------------------
+
+#: The C-struct layout the native library and the fast path's
+#: pre-serialized template bytes assume: i64 at 0, six u32s after.
+_LANE_STRUCT = struct.Struct("<q6I")
+_LANE_OFFSETS = {
+    "expiry": 0,
+    "hits": 8,
+    "limits": 12,
+    "len": 16,
+    "shadow": 20,
+    "divider": 24,
+    "algo": 28,
+}
+
+
+def test_lane_dtype_layout_is_pinned():
+    """PR 6 widened the lane record 24 -> 32 bytes; this pins every
+    field's offset and the itemsize so the next widening must update
+    the native consumers (and this test) together."""
+    assert LANE_DTYPE.itemsize == _LANE_STRUCT.size == 32
+    assert list(LANE_DTYPE.names) == list(_LANE_OFFSETS)
+    for name, want in _LANE_OFFSETS.items():
+        field_dtype, offset = LANE_DTYPE.fields[name]
+        assert offset == want, name
+        assert field_dtype.itemsize in (4, 8)
+        assert offset % field_dtype.itemsize == 0, name  # natural alignment
+
+
+def test_lane_struct_pack_frombuffer_round_trip():
+    """A row packed with the C layout parses identically through the
+    numpy dtype — the exact reinterpretation the collector does on
+    concatenated template bytes."""
+    raw = _LANE_STRUCT.pack(1234567890123, 5, 60, 11, 1, 3600, 2)
+    [row] = np.frombuffer(raw, dtype=LANE_DTYPE)
+    assert row["expiry"] == 1234567890123
+    assert row["hits"] == 5
+    assert row["limits"] == 60
+    assert row["len"] == 11
+    assert row["shadow"] == 1
+    assert row["divider"] == 3600
+    assert row["algo"] == 2
+
+
+def test_lane_pack_from_lanes_matches_itemsize():
+    pack = LanePack.from_lanes(
+        [Lane(key="k" * 9, expiry=7, hits=1, limit=10, shadow=False)]
+    )
+    assert pack.meta.nbytes == LANE_DTYPE.itemsize
+    assert pack.meta_u8.nbytes == LANE_DTYPE.itemsize
+
+
+# -- the static checker's model vs the live objects --------------------------
+
+
+def _static_decl(path, name):
+    source = open(path, encoding="utf-8").read()
+    ctx = build_context(path, source)
+    mod = ModuleInfo(module_name_for(path), ctx)
+    decls = {d.name: d for d in parse_dtype_decls(mod)}
+    assert name in decls, f"{name} not statically parseable in {path}"
+    return decls[name]
+
+
+def test_static_model_matches_live_flight_dtype():
+    decl = _static_decl(
+        "ratelimit_tpu/observability/flight.py", "FLIGHT_DTYPE"
+    )
+    assert decl.itemsize == FLIGHT_DTYPE.itemsize
+    assert [f[0] for f in decl.fields] == list(FLIGHT_DTYPE.names)
+    for name in FLIGHT_DTYPE.names:
+        assert decl.offsets[name] == FLIGHT_DTYPE.fields[name][1], name
+
+
+def test_static_model_matches_live_lane_dtype():
+    decl = _static_decl(
+        "ratelimit_tpu/backends/dispatcher.py", "LANE_DTYPE"
+    )
+    assert decl.itemsize == LANE_DTYPE.itemsize
+    assert [f[0] for f in decl.fields] == list(LANE_DTYPE.names)
+    for name in LANE_DTYPE.names:
+        assert decl.offsets[name] == LANE_DTYPE.fields[name][1], name

@@ -1,0 +1,313 @@
+"""tpu-lint v2: whole-program index, interprocedural rules, contract
+checker, and the baseline ratchet (ISSUE 7 tentpole).
+
+Each project rule is demonstrated on multi-file fixture packages
+(tests/lint_fixtures/project/): true positive with a CROSS-MODULE
+cause, true negative, and suppression honored through the engine's
+existing line-suppression machinery.  The full-tree run at the bottom
+is the acceptance gate: clean at HEAD and fast (< 10s).
+"""
+
+import json
+import time
+from pathlib import Path
+
+import pytest
+
+from ratelimit_tpu.analysis.baseline import (
+    load_baseline,
+    new_findings,
+    write_baseline,
+)
+from ratelimit_tpu.analysis.concurrency import make_concurrency_rules
+from ratelimit_tpu.analysis.contracts import make_contract_rules
+from ratelimit_tpu.analysis.engine import Finding, analyze_paths
+from ratelimit_tpu.analysis.project import ProjectIndex, module_name_for
+from ratelimit_tpu.analysis.engine import build_context
+from ratelimit_tpu.analysis.__main__ import main as cli_main
+
+FIXTURES = Path(__file__).parent / "lint_fixtures" / "project"
+REPO_ROOT = Path(__file__).parent.parent
+
+
+def project_findings(subdir):
+    """Whole-program findings for one fixture package, file rules off
+    (isolates the interprocedural pass)."""
+    findings, _ = analyze_paths(
+        [str(FIXTURES / subdir)],
+        rules=[],
+        project_rules=make_concurrency_rules() + make_contract_rules(),
+    )
+    return findings
+
+
+def by_rule(findings, rule_id):
+    return [f for f in findings if f.rule_id == rule_id]
+
+
+def build_index(subdir):
+    ctxs = []
+    for p in sorted((FIXTURES / subdir).rglob("*.py")):
+        ctx = build_context(str(p), p.read_text(encoding="utf-8"))
+        assert not isinstance(ctx, Finding), p
+        ctxs.append(ctx)
+    return ProjectIndex(ctxs)
+
+
+# -- lock-order-cycle --------------------------------------------------------
+
+
+def test_lock_order_cycle_cross_module_true_positive():
+    findings = project_findings("deadlock")
+    [f] = by_rule(findings, "lock-order-cycle")
+    # both lock identities and both modules are named in one message
+    assert "A._a_lock" in f.message and "B._b_lock" in f.message
+    assert "a.py" in f.message and "b.py" in f.message
+    assert "deadlock" in f.message
+
+
+def test_lock_order_consistent_order_true_negative():
+    assert by_rule(project_findings("deadlock_ok"), "lock-order-cycle") == []
+
+
+def test_lock_order_edges_reach_through_calls():
+    """The cycle exists only through calls: neither file nests the
+    two `with` statements lexically."""
+    index = build_index("deadlock")
+    step = index.functions["deadlock.a:A.step"]
+    [cs] = [c for c in step.call_sites if c.callee is not None]
+    assert cs.callee.qualname == "deadlock.b:B.poke"
+    assert cs.held == ("A._a_lock",)
+
+
+# -- blocking-under-lock -----------------------------------------------------
+
+
+def test_blocking_under_lock_cross_module_true_positive():
+    findings = project_findings("blocking")
+    [f] = by_rule(findings, "blocking-under-lock")
+    assert f.path.endswith("store.py")  # anchored at the call site
+    assert "time.sleep()" in f.message
+    assert "blocking.disk:persist" in f.message  # the chain is named
+    assert "Store._state_lock" in f.message
+
+
+def test_blocking_outside_lock_and_cv_idiom_true_negative():
+    assert by_rule(project_findings("blocking_ok"), "blocking-under-lock") == []
+
+
+# -- shared-state ------------------------------------------------------------
+
+
+def test_shared_state_two_contexts_true_positive():
+    findings = project_findings("shared")
+    [f] = by_rule(findings, "shared-state")
+    assert "Worker.backlog" in f.message
+    assert "thread:" in f.message and "main" in f.message
+    assert f.path.endswith("worker.py")
+
+
+def test_shared_state_locked_writes_true_negative():
+    """Same two-context shape; every write under the lock, including
+    through the lock-dominated `_push` helper."""
+    assert by_rule(project_findings("shared_ok"), "shared-state") == []
+
+
+def test_shared_state_suppression_honored():
+    findings = project_findings("shared_suppressed")
+    assert by_rule(findings, "shared-state") == []
+
+
+def test_thread_roots_discovered():
+    index = build_index("shared")
+    [root] = index.thread_roots
+    assert root.fn.qualname == "shared.worker:Worker._loop"
+    assert root.path.endswith("worker.py")
+
+
+# -- dtype-pack-contract -----------------------------------------------------
+
+
+def test_pack_format_drift_true_positive():
+    findings = project_findings("contracts")
+    drift = [
+        f
+        for f in by_rule(findings, "dtype-pack-contract")
+        if f.path.endswith("pack_drift.py")
+    ]
+    [f] = drift
+    assert "'<3q'" in f.message and "RECORD_DTYPE" in f.message
+    assert "qII" in f.message  # the expected field chars are spelled out
+
+
+def test_misaligned_layout_true_positive():
+    findings = project_findings("contracts")
+    layout = [
+        f
+        for f in by_rule(findings, "dtype-pack-contract")
+        if f.path.endswith("layout_bad.py")
+    ]
+    msgs = " | ".join(f.message for f in layout)
+    assert "offset 4" in msgs  # i8 misaligned
+    assert "not a" in msgs and "multiple of 8" in msgs  # itemsize 12
+
+
+def test_f64_on_device_path_true_positive():
+    findings = project_findings("contracts")
+    f64 = [
+        f
+        for f in by_rule(findings, "dtype-pack-contract")
+        if f.path.endswith("f64_device.py")
+    ]
+    assert len(f64) == 2  # dtype="float64" keyword + np.float64 call
+    assert all("f64" in f.message or "float64" in f.message for f in f64)
+
+
+def test_clean_pair_true_negative():
+    findings = project_findings("contracts")
+    assert not [f for f in findings if f.path.endswith("clean_pair.py")]
+    assert not [f for f in findings if f.path.endswith("__init__.py")]
+
+
+def test_pack_contract_cross_module_import():
+    """decl.py declares, writer.py imports and drifts: the finding
+    lands in writer.py and names the dtype declared elsewhere."""
+    findings = project_findings("contracts_xmod")
+    [f] = by_rule(findings, "dtype-pack-contract")
+    assert f.path.endswith("writer.py")
+    assert "WIDE_DTYPE" in f.message
+
+
+# -- ProjectIndex mechanics --------------------------------------------------
+
+
+def test_module_naming_walks_packages():
+    assert (
+        module_name_for("ratelimit_tpu/backends/dispatcher.py")
+        == "ratelimit_tpu.backends.dispatcher"
+    )
+    assert module_name_for(
+        str(FIXTURES / "deadlock" / "a.py")
+    ) == "deadlock.a"
+
+
+def test_typed_attribute_call_resolution():
+    index = build_index("shared")
+    handle = index.functions["shared.service:Service.handle"]
+    [cs] = [c for c in handle.call_sites if c.callee is not None]
+    assert cs.callee.qualname == "shared.worker:Worker.bump"
+
+
+def test_entry_functions_exclude_called_and_rooted():
+    index = build_index("shared")
+    entries = {f.qualname for f in index.entry_functions()}
+    assert "shared.service:Service.handle" in entries
+    assert "shared.worker:Worker.bump" not in entries  # called by handle
+    assert "shared.worker:Worker._loop" not in entries  # thread root
+
+
+# -- baseline ratchet --------------------------------------------------------
+
+
+def _finding(rule="r", path="p.py", line=3, message="m"):
+    return Finding(rule_id=rule, path=path, line=line, col=0, message=message)
+
+
+def test_new_findings_multiset_semantics():
+    doc = {
+        "version": 1,
+        "findings": [
+            {"rule": "r", "path": "p.py", "line": 3, "message": "m"}
+        ],
+    }
+    known = _finding()
+    moved = _finding(line=99)  # same identity, shifted by edits
+    extra = _finding(message="other")
+    assert new_findings([known], doc) == []
+    assert new_findings([moved], doc) == []  # line is not identity
+    assert new_findings([known, extra], doc) == [extra]
+    # a SECOND instance of a known finding is new (multiset budget)
+    assert new_findings([known, moved], doc) == [moved]
+
+
+def test_write_then_load_round_trip(tmp_path):
+    p = tmp_path / "base.json"
+    write_baseline([_finding(), _finding(rule="s")], str(p))
+    doc = load_baseline(str(p))
+    assert {f["rule"] for f in doc["findings"]} == {"r", "s"}
+    assert new_findings([_finding()], doc) == []
+
+
+def test_absent_baseline_is_empty_and_malformed_raises(tmp_path):
+    assert load_baseline(str(tmp_path / "nope.json"))["findings"] == []
+    bad = tmp_path / "bad.json"
+    bad.write_text("[1, 2, 3]")
+    with pytest.raises(ValueError, match="malformed"):
+        load_baseline(str(bad))
+
+
+def test_cli_fail_on_new_ratchet(tmp_path, capsys):
+    """End-to-end ratchet on a fixture package with real findings:
+    write the baseline, then --fail-on-new passes (all known) and a
+    fresh tree without the baseline fails."""
+    target = str(FIXTURES / "deadlock")
+    base = str(tmp_path / "baseline.json")
+
+    assert cli_main(["--write-baseline", "--baseline", base, target]) == 0
+    out = capsys.readouterr().out
+    assert "wrote" in out
+
+    # everything is baselined: exit 0, the known count is reported
+    assert cli_main(["--fail-on-new", "--baseline", base, target]) == 0
+    out = capsys.readouterr().out
+    assert "0 finding(s)" in out and "suppressed by baseline" in out
+
+    # without --fail-on-new the same tree still fails (findings exist)
+    assert cli_main([target]) == 1
+    capsys.readouterr()
+
+    # JSON format reports the baselined count
+    assert (
+        cli_main(
+            ["--fail-on-new", "--baseline", base, "--format=json", target]
+        )
+        == 0
+    )
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["count"] == 0 and doc["baselined"] >= 1
+
+
+def test_cli_fail_on_new_flags_regressions(tmp_path, capsys):
+    """A finding absent from the baseline fails the run even when the
+    baseline covers others."""
+    base = str(tmp_path / "baseline.json")
+    ok_target = str(FIXTURES / "deadlock_ok")
+    bad_target = str(FIXTURES / "deadlock")
+    assert cli_main(["--write-baseline", "--baseline", base, ok_target]) == 0
+    capsys.readouterr()
+    assert cli_main(["--fail-on-new", "--baseline", base, bad_target]) == 1
+    out = capsys.readouterr().out
+    assert "lock-order-cycle" in out
+
+
+def test_committed_baseline_is_empty_at_head():
+    """The tree is clean, so the committed ratchet file must hold
+    zero findings — a grown baseline is a conscious, reviewed change,
+    never drift."""
+    doc = load_baseline()
+    assert doc["findings"] == []
+
+
+# -- the acceptance gate -----------------------------------------------------
+
+
+def test_full_tree_clean_and_fast():
+    """`make lint` semantics: the v2 engine (file + project rules)
+    over the whole package is clean at HEAD and completes well under
+    the 10s budget."""
+    t0 = time.monotonic()
+    findings, n_files = analyze_paths([str(REPO_ROOT / "ratelimit_tpu")])
+    elapsed = time.monotonic() - t0
+    assert findings == [], [f.text() for f in findings]
+    assert n_files > 60
+    assert elapsed < 10.0, f"analyzer took {elapsed:.1f}s"

@@ -1,0 +1,320 @@
+"""analysis/sanitizer.py: the runtime lock/atomicity sanitizer
+(``TPU_SANITIZE=1`` / ``make sanitize``).
+
+Each test runs against a FRESH LockSanitizer swapped in for the
+module global, so deliberately-provoked violations never leak into
+the session sanitizer (under ``make sanitize`` the conftest
+sessionfinish hook fails the run on ANY recorded violation — these
+tests must not trip it).
+"""
+
+import threading
+import time
+
+import pytest
+
+from ratelimit_tpu.analysis import sanitizer
+
+
+@pytest.fixture
+def san(monkeypatch):
+    """A fresh, installed sanitizer; the session one (if active) is
+    suspended for the duration and restored afterwards."""
+    prev = sanitizer.get()
+    prev_installed = prev.installed
+    prev_raise = prev.raise_on_violation
+    if prev_installed:
+        prev.uninstall()
+    fresh = sanitizer.LockSanitizer()
+    monkeypatch.setattr(sanitizer, "_SANITIZER", fresh)
+    fresh.install()
+    try:
+        yield fresh
+    finally:
+        fresh.uninstall()
+        monkeypatch.setattr(sanitizer, "_SANITIZER", prev)
+        if prev_installed:
+            prev.install(raise_on_violation=prev_raise)
+
+
+def _in_thread(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+
+
+def _kinds(s):
+    return [v.kind for v in s.violations()]
+
+
+# -- lock-order cycles -------------------------------------------------------
+
+
+def test_ab_ba_inversion_is_reported(san):
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+    assert isinstance(lock_a, sanitizer._SanitizedLockBase)
+
+    def t1():
+        with lock_a:
+            with lock_b:
+                pass
+
+    def t2():
+        with lock_b:
+            with lock_a:
+                pass
+
+    _in_thread(t1)
+    assert _kinds(san) == []  # one order alone is fine
+    _in_thread(t2)
+    assert _kinds(san) == ["lock-order-cycle"]
+    detail = san.violations()[0].detail
+    assert "test_sanitizer.py" in detail  # creation sites named
+
+
+def test_consistent_order_is_clean(san):
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+
+    def worker():
+        with lock_a:
+            with lock_b:
+                pass
+
+    for _ in range(3):
+        _in_thread(worker)
+    assert san.violations() == []
+
+
+def test_three_lock_cycle_is_reported(san):
+    # Distinct creation LINES on purpose: identity is the creation
+    # site, and one shared line would fold all three into one class.
+    la = threading.Lock()
+    lb = threading.Lock()
+    lc = threading.Lock()
+
+    def order(x, y):
+        with x:
+            with y:
+                pass
+
+    _in_thread(lambda: order(la, lb))
+    _in_thread(lambda: order(lb, lc))
+    assert _kinds(san) == []
+    _in_thread(lambda: order(lc, la))  # closes a->b->c->a
+    assert _kinds(san) == ["lock-order-cycle"]
+
+
+def test_same_creation_site_shares_identity(san):
+    """Two instances allocated at ONE site form a lockdep class: an
+    inversion between two Counter instances' locks and another lock
+    is still an inversion."""
+    def make():
+        return threading.Lock()  # one shared creation site
+
+    inst1, inst2 = make(), make()
+    other = threading.Lock()
+
+    _in_thread(lambda: [other.acquire(), inst1.acquire(),
+                        inst1.release(), other.release()])
+    _in_thread(lambda: [inst2.acquire(), other.acquire(),
+                        other.release(), inst2.release()])
+    assert _kinds(san) == ["lock-order-cycle"]
+
+
+def test_rlock_reentrancy_is_not_a_cycle(san):
+    r = threading.RLock()
+    lock_b = threading.Lock()
+
+    def worker():
+        with r:
+            with r:  # reentry: no self-edge, no double-count
+                with lock_b:
+                    pass
+
+    _in_thread(worker)
+    assert san.violations() == []
+
+
+def test_duplicate_violation_reported_once(san):
+    la = threading.Lock()
+    lb = threading.Lock()
+
+    def t1():
+        with la:
+            with lb:
+                pass
+
+    def t2():
+        with lb:
+            with la:
+                pass
+
+    _in_thread(t1)
+    for _ in range(3):
+        _in_thread(t2)
+    assert len(san.violations()) == 1
+
+
+# -- held-across-blocking-call ----------------------------------------------
+
+
+def test_sleep_under_lock_is_reported(san):
+    lock = threading.Lock()
+
+    def worker():
+        with lock:
+            time.sleep(0)
+
+    _in_thread(worker)
+    assert _kinds(san) == ["held-across-blocking-call"]
+    assert "time.sleep" in san.violations()[0].detail
+
+
+def test_sleep_outside_lock_is_clean(san):
+    lock = threading.Lock()
+
+    def worker():
+        with lock:
+            pass
+        time.sleep(0)
+
+    _in_thread(worker)
+    assert san.violations() == []
+
+
+def test_untimed_event_wait_under_lock_is_reported(san):
+    lock = threading.Lock()
+    ev = threading.Event()
+    ev.set()  # wait() returns immediately; the report is about intent
+
+    def worker():
+        with lock:
+            ev.wait()
+
+    _in_thread(worker)
+    assert _kinds(san) == ["held-across-blocking-call"]
+    assert "Event.wait" in san.violations()[0].detail
+
+
+def test_allow_blocking_scope_suppresses_with_justification(san):
+    """The runtime analog of a `-- why` suppression: blocking inside
+    an allow_blocking() scope is sanctioned, outside it still
+    reports, and an empty justification is rejected."""
+    lock = threading.Lock()
+
+    def worker():
+        with lock:
+            with sanitizer.allow_blocking("non-blocking gate: 409s"):
+                time.sleep(0)
+
+    _in_thread(worker)
+    assert san.violations() == []
+
+    def worker_outside():
+        with lock:
+            time.sleep(0)
+
+    _in_thread(worker_outside)
+    assert _kinds(san) == ["held-across-blocking-call"]
+
+    with pytest.raises(ValueError, match="justification"):
+        sanitizer.allow_blocking("")
+
+
+def test_timed_event_wait_under_lock_is_clean(san):
+    lock = threading.Lock()
+    ev = threading.Event()
+
+    def worker():
+        with lock:
+            ev.wait(timeout=0.001)
+
+    _in_thread(worker)
+    assert san.violations() == []
+
+
+# -- condition-variable protocol --------------------------------------------
+
+
+def test_condition_wait_unwinds_held_stack(san):
+    """threading.Condition's default RLock is sanitized; cv.wait()
+    must fully release (held stack pops) and re-acquire (pushes
+    back), leaving no phantom held entries."""
+    cv = threading.Condition()
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=0.01)
+        assert sanitizer._TLS.held == []
+
+    _in_thread(waiter)
+    assert san.violations() == []
+
+
+def test_condition_notify_round_trip(san):
+    cv = threading.Condition()
+    ready = []
+
+    def consumer():
+        with cv:
+            while not ready:
+                cv.wait(timeout=1.0)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    with cv:
+        ready.append(1)
+        cv.notify()
+    t.join()
+    assert san.violations() == []
+
+
+# -- scope / install hygiene -------------------------------------------------
+
+
+def test_out_of_scope_locks_pass_through(san):
+    """Locks created from files outside TPU_SANITIZE_SCOPE are raw —
+    zero overhead, no tracking."""
+    san.scope = ("no/such/prefix",)
+    raw = threading.Lock()
+    assert not isinstance(raw, sanitizer._SanitizedLockBase)
+
+
+def test_uninstall_restores_factories(san):
+    assert threading.Lock is not san._orig["Lock"]
+    san.uninstall()
+    try:
+        assert threading.Lock is san._orig["Lock"]
+        assert time.sleep is san._orig["sleep"]
+    finally:
+        san.install()  # fixture teardown uninstalls again
+
+
+def test_raise_on_violation_raises_at_site(san):
+    san.raise_on_violation = True
+    lock = threading.Lock()
+    with pytest.raises(RuntimeError, match="TPU_SANITIZE"):
+        with lock:
+            time.sleep(0)
+    assert sanitizer._TLS.held == []  # with-block unwound cleanly
+
+
+def test_clear_resets_graph_and_violations(san):
+    lock = threading.Lock()
+    with lock:
+        time.sleep(0)
+    assert san.violations()
+    san.clear()
+    assert san.violations() == []
+    assert "no violations" in san.format_report()
+
+
+def test_format_report_names_the_violation(san):
+    lock = threading.Lock()
+    with lock:
+        time.sleep(0)
+    report = san.format_report()
+    assert "1 violation(s)" in report
+    assert "held-across-blocking-call" in report

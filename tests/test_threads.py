@@ -1,0 +1,115 @@
+"""utils/threads.py: background-thread crash visibility (ISSUE 7
+satellite).  A daemon thread dying from an uncaught exception must
+fail the owning test (conftest wires the recorder session-wide) and
+scream in the service log (runner wires the logging hook) instead of
+printing to bare stderr and vanishing.
+"""
+
+import logging
+import threading
+
+import pytest
+
+from ratelimit_tpu.utils.threads import (
+    ThreadExceptionRecorder,
+    install_thread_excepthook,
+)
+
+
+@pytest.fixture
+def hook_guard():
+    """Restore the process-wide threading.excepthook after the test —
+    these tests install their own hooks on top of conftest's."""
+    prev = threading.excepthook
+    yield
+    threading.excepthook = prev
+
+
+def _crash_thread(exc, name="crasher"):
+    def boom():
+        raise exc
+
+    t = threading.Thread(target=boom, name=name, daemon=True)
+    t.start()
+    t.join()
+
+
+def test_recorder_collects_and_drains():
+    rec = ThreadExceptionRecorder()
+    e = ValueError("x")
+    rec.record("t-1", e)
+    rec.record("t-2", e)
+    assert [n for n, _ in rec.pending()] == ["t-1", "t-2"]
+    assert rec.drain() == [("t-1", e), ("t-2", e)]
+    assert rec.pending() == [] and rec.drain() == []
+
+
+def test_hook_records_crashing_thread(hook_guard, thread_exceptions):
+    rec = ThreadExceptionRecorder()
+    install_thread_excepthook(rec.record)
+    _crash_thread(RuntimeError("sampler died"))
+    [(name, exc)] = rec.drain()
+    assert name == "crasher"
+    assert isinstance(exc, RuntimeError) and "sampler died" in str(exc)
+    # conftest's session hook chains BELOW ours and saw it too:
+    # acknowledge so the autouse fixture doesn't fail this test.
+    assert thread_exceptions.drain()
+
+
+def test_hook_logs_at_error(hook_guard, thread_exceptions, caplog):
+    install_thread_excepthook(logger_name="test.threads")
+    with caplog.at_level(logging.ERROR, logger="test.threads"):
+        _crash_thread(RuntimeError("flusher died"), name="flush-0")
+    assert any(
+        "flush-0" in r.message and r.levelno == logging.ERROR
+        for r in caplog.records
+    )
+    thread_exceptions.drain()  # acknowledge (chained session hook)
+
+
+def test_hook_chains_to_previous_custom_hook(hook_guard, thread_exceptions):
+    seen = []
+
+    def older_hook(args):
+        seen.append(args.thread.name)
+
+    threading.excepthook = older_hook
+    rec = ThreadExceptionRecorder()
+    install_thread_excepthook(rec.record)
+    _crash_thread(KeyError("k"), name="chained")
+    assert seen == ["chained"]
+    assert [n for n, _ in rec.drain()] == ["chained"]
+
+
+def test_hook_ignores_system_exit(hook_guard, thread_exceptions):
+    """SystemExit is a normal thread shutdown (mirrors the stdlib
+    default hook): neither recorded nor logged."""
+    rec = ThreadExceptionRecorder()
+    install_thread_excepthook(rec.record)
+    _crash_thread(SystemExit(0), name="exiter")
+    assert rec.drain() == []
+    assert thread_exceptions.pending() == []
+
+
+def test_callback_exception_does_not_escape(hook_guard, thread_exceptions):
+    """A broken recorder callback must never take the hook down with
+    it (the hook runs inside threading's crash path)."""
+
+    def bad_callback(name, exc):
+        raise RuntimeError("recorder itself broke")
+
+    install_thread_excepthook(bad_callback)
+    _crash_thread(ValueError("original"), name="victim")
+    # the chained session recorder still saw the ORIGINAL crash
+    crashed = thread_exceptions.drain()
+    assert any(isinstance(e, ValueError) for _, e in crashed)
+
+
+def test_session_recorder_sees_real_crash(thread_exceptions):
+    """End-to-end through conftest's session-wide hook: a background
+    thread dying lands in the shared recorder (drained here to
+    acknowledge — the autouse fixture would otherwise fail us, which
+    is exactly the behavior the satellite asked for)."""
+    _crash_thread(RuntimeError("dispatcher collector died"), name="bg")
+    crashed = thread_exceptions.drain()
+    assert [n for n, _ in crashed] == ["bg"]
