@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: all test lint sanitize bench bench-host protos native serve check_config smoke_client metrics-smoke docker_image e2e e2e-local ci clean
+.PHONY: all test lint sanitize bench bench-host replay-smoke protos native serve check_config smoke_client metrics-smoke docker_image e2e e2e-local ci clean
 
 # C++ hot-path library: slot table + decide kernel (auto-built on
 # first import too; this forces it).  Goes through the Python builder
@@ -47,6 +47,14 @@ bench:
 # docs/HOST_PATH.md.  Pure host work; no device step.
 bench-host:
 	$(CPU_ENV) $(PY) benchmarks/profile_host_path.py --quick
+
+# Overload-control smoke: replay the committed tiny flight ring
+# (benchmarks/data/flight_ring_sample.jsonl) at forced overload
+# through a live controller and assert shed counters move, shed-coded
+# flight records land in the ring, and the p99 artifact rows are
+# well-formed (benchmarks/replay.py; docs/OBSERVABILITY.md).
+replay-smoke:
+	$(CPU_ENV) PALLAS_AXON_POOL_IPS= $(PY) benchmarks/replay.py --smoke
 
 # Regenerate committed protobuf classes after editing protos/.
 protos:
@@ -94,7 +102,7 @@ e2e-local:
 # The full CI recipe (.github/workflows/ci.yaml runs exactly this):
 # native build, tests, offline config validation, black-box e2e,
 # bench smoke on the CPU platform.
-ci: lint native test sanitize check_config metrics-smoke bench-host e2e-local
+ci: lint native test sanitize check_config metrics-smoke bench-host replay-smoke e2e-local
 	$(CPU_ENV) PALLAS_AXON_POOL_IPS= $(PY) bench.py
 
 clean:

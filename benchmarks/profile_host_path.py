@@ -347,8 +347,8 @@ def profile_hotkeys():
     svc, cache = build(2 * DUP_KEYS)
     config = svc.get_current_config()
     req = reqs[0]
-    (items, statuses, categories, _keys, limits, _unl, hits_addend, now, hot
-     ) = cache._prepare_resolved(req, config)
+    (items, statuses, categories, _keys, limits, _unl, hits_addend, now, hot,
+     _shadow) = cache._prepare_resolved(req, config)
     statuses = cache._execute(
         limits, items, statuses, categories, hits_addend, now,
         len(req.descriptors),
@@ -552,7 +552,208 @@ def profile_flight():
     return results
 
 
+def profile_overload():
+    """Per-request cost of the overload-control hot path
+    (overload/controller.py), measured through the real serving seams
+    (same harness as profile_flight), against the acceptance budget —
+    <= ~1.5us/request with the controllers ENABLED and idle, ~0 with
+    the layer absent (the runner builds no controller at defaults).
+
+    Legs:
+
+    - ``promo``:  the promotion-cache branch in _prepare_resolved —
+                  attached-and-empty PromotionCache vs None (the
+                  common case: promotion enabled, nothing currently
+                  promoted);
+    - ``admit``:  OverloadController.admit() per request with every
+                  loop enabled and nothing tripped (one dict probe +
+                  compares + tuple) — the service-side leg;
+    - ``shed``:   admit() while actively shedding (the refusal path
+                  must be CHEAPER than serving, or shedding cannot
+                  relieve anything);
+    - ``parity``: decisions field-identical with the idle controller
+                  + empty promotion attached vs absent.
+    """
+    from ratelimit_tpu.api import Descriptor, RateLimitRequest  # noqa: E402
+    from ratelimit_tpu.backends.tpu_cache import TpuRateLimitCache  # noqa: E402
+    from ratelimit_tpu.overload import OverloadController, PromotionCache  # noqa: E402
+    from ratelimit_tpu.service import RateLimitService  # noqa: E402
+    from ratelimit_tpu.stats.manager import Manager  # noqa: E402
+    from ratelimit_tpu.utils.time import PinnedTimeSource  # noqa: E402
+
+    n_reqs = 256
+    reps = 12
+    yaml = (
+        "domain: domain\n"
+        "priority: 2\n"
+        "descriptors:\n"
+        "  - key: key\n"
+        "    rate_limit:\n"
+        "      unit: hour\n"
+        "      requests_per_unit: 1000\n"
+    )
+
+    class _Runtime:
+        def __init__(self, files):
+            self._files = files
+
+        def snapshot(self):
+            files = self._files
+
+            class Snap:
+                def keys(self):
+                    return sorted(files)
+
+                def get(self, key):
+                    return files.get(key, "")
+
+            return Snap()
+
+        def add_update_callback(self, fn):
+            pass
+
+    def build():
+        clock = PinnedTimeSource(1_700_000_000)
+        engine = CounterEngine(num_slots=1 << 16)
+        cache = TpuRateLimitCache(engine, clock)
+        svc = RateLimitService(
+            _Runtime({"config.bench": yaml}), cache, Manager(), clock=clock
+        )
+        return svc, cache
+
+    rng = np.random.default_rng(7)
+    key_ids = rng.integers(0, DUP_KEYS, n_reqs * 4)
+    reqs = []
+    for r in range(n_reqs):
+        descs = [
+            Descriptor.of(("key", f"value{key_ids[r * 4 + j]}"))
+            for j in range(4)
+        ]
+        reqs.append(RateLimitRequest("domain", descs, 0))
+
+    def front(svc, cache):
+        pool = cache._event_pool
+        config = svc.get_current_config()
+        for req in reqs:
+            items, *_ = cache._prepare_resolved(req, config)
+            if len(pool) < 1024:
+                for _bank, _eng, item in items:
+                    pool.append(item.event)
+
+    import gc
+
+    gc.collect()
+    results = {"requests": n_reqs, "descriptors_per_request": 4}
+
+    # Leg 1: the promotion-cache branch in the resolved front half —
+    # interleaved best-of A/B like profile_flight (the delta is well
+    # under run-to-run median noise).
+    built = {"off": build(), "on": build()}
+    built["on"][1].promotion = PromotionCache(ttl_s=2.0, capacity=1024)
+    for name, (svc, cache) in built.items():
+        front(svc, cache)  # warm the resolution cache
+    times = {"on": [], "off": []}
+    for _ in range(4 * reps):
+        for name, (svc, cache) in built.items():
+            t0 = time.perf_counter()
+            front(svc, cache)
+            times[name].append(time.perf_counter() - t0)
+    t_on, t_off = min(times["on"]), min(times["off"])
+    results["front_promo_off_us_per_req"] = t_off / n_reqs * 1e6
+    results["front_promo_on_us_per_req"] = t_on / n_reqs * 1e6
+    results["promo_overhead_us_per_req"] = (t_on - t_off) / n_reqs * 1e6
+
+    # Leg 2: admit() enabled-idle vs the absent-controller None guard
+    # (the service hot path's exact shape).
+    ctrl = OverloadController(
+        shed_enabled=True,
+        promote_enabled=True,
+        backpressure_enabled=True,
+        backpressure_max_wait_s=0.0,
+    )
+    ctrl.set_priorities({"domain": 2})
+
+    def admit_enabled():
+        admit = ctrl.admit
+        for _req in reqs:
+            reason, gate = admit("domain")
+            if gate is not None:  # pragma: no cover - gate idle
+                gate.release()
+
+    none_ctrl = None
+
+    def admit_disabled():
+        for _req in reqs:
+            if none_ctrl is not None:
+                none_ctrl.admit("domain")
+
+    admit_enabled()
+    t_on, _ = timed(admit_enabled, reps=reps)
+    t_off, _ = timed(admit_disabled, reps=reps)
+    results["admit_enabled_us_per_req"] = t_on / n_reqs * 1e6
+    results["admit_disabled_us_per_req"] = t_off / n_reqs * 1e6
+    results["admit_overhead_us_per_req"] = (t_on - t_off) / n_reqs * 1e6
+    results["total_overhead_us_per_req"] = (
+        results["promo_overhead_us_per_req"]
+        + results["admit_overhead_us_per_req"]
+    )
+
+    # Leg 3: the refusal path while actively shedding.
+    ctrl._floor = 1
+    ctrl._recompute_shed_locked()
+    t_shed, _ = timed(
+        lambda: [ctrl.admit("stranger") for _ in reqs], reps=reps
+    )
+    results["admit_shedding_us_per_req"] = t_shed / n_reqs * 1e6
+    ctrl._floor = 0
+    ctrl._recompute_shed_locked()
+
+    # Leg 4: decision parity with the idle layer attached.
+    svc_off, cache_off = built["off"]
+    svc_on, cache_on = built["on"]
+    svc_on.overload = ctrl
+    identical = True
+    for req in reqs:
+        st_on, _lim, unl_on = cache_on.do_limit_resolved(
+            req, svc_on.get_current_config()
+        )
+        st_off, _lim2, unl_off = cache_off.do_limit_resolved(
+            req, svc_off.get_current_config()
+        )
+        a = [
+            (s.code, s.limit_remaining, s.duration_until_reset)
+            for s in st_on
+        ]
+        b = [
+            (s.code, s.limit_remaining, s.duration_until_reset)
+            for s in st_off
+        ]
+        if a != b or unl_on != unl_off:
+            identical = False
+            break
+    results["decisions_identical_idle_on_off"] = identical
+    results["budget_us_per_req"] = 1.5
+    results["within_budget"] = (
+        results["total_overhead_us_per_req"] <= 1.5
+    )
+
+    path = os.path.join(
+        os.path.dirname(__file__), "results", "overload_overhead.json"
+    )
+    with open(path, "w") as f:
+        json.dump(results, f, indent=2)
+    print(json.dumps(results, indent=2))
+    print(f"wrote {path}")
+    if not identical:
+        print("FAIL: decisions differ with idle overload layer attached")
+        sys.exit(1)
+    return results
+
+
 def main():
+    if "--overload" in sys.argv:
+        profile_overload()
+        sys.exit(0)
     if "--flight" in sys.argv:
         profile_flight()
         sys.exit(0)

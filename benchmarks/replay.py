@@ -1,0 +1,856 @@
+"""Workload replay harness + closed-loop overload benchmark.
+
+The flight recorder (observability/flight.py) keeps the last N
+decisions — domain, key-stem hash, inter-arrival delta, hits_addend.
+That is EXACTLY a workload description, so this harness closes the
+telemetry loop twice over: traffic captured from a live replica
+(``GET /debug/flight?format=jsonl``) replays against a fresh stack,
+and synthetic Zipf/burst/diurnal generators produce streams with the
+same :class:`Event` interface — one driver measures them all.  It
+extends benchmarks/closed_loop_p99.py (whose closed loop measures
+serving latency at fixed concurrency) with the OPEN-loop measurement
+overload control needs: arrivals follow a fixed schedule at
+``factor x`` the measured capacity, latency is measured from the
+SCHEDULED arrival (so backlog shows up as latency instead of silently
+slowing the offered rate), and the overload controller
+(overload/controller.py) runs live against the stream.
+
+The committed artifact (benchmarks/results/replay_overload.json, from
+a full run) demonstrates the control loop closed: at 2x offered load
+the CONTROLLED run sheds the low-priority ``guest``/``_other`` traffic
+and holds the top-priority domain's p99 and goodput bounded, while the
+UNCONTROLLED run's backlog — and therefore every domain's p99 — grows
+without bound for the duration of the run.
+
+Run:
+  JAX_PLATFORMS=cpu python benchmarks/replay.py            # full artifact
+  JAX_PLATFORMS=cpu python benchmarks/replay.py --smoke    # CI smoke (make replay-smoke)
+  JAX_PLATFORMS=cpu python benchmarks/replay.py --record   # regenerate the sample ring
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import math
+import os
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+from closed_loop_p99 import pct  # noqa: E402  (the shared quantile helper)
+
+SAMPLE_RING = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "data",
+    "flight_ring_sample.jsonl",
+)
+RESULTS = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "results",
+    "replay_overload.json",
+)
+
+PAYING_YAML = (
+    "domain: paying\n"
+    "priority: 2\n"
+    "descriptors:\n"
+    "  - key: k\n"
+    "    value: hot\n"
+    "    rate_limit:\n"
+    "      unit: minute\n"
+    "      requests_per_unit: 50\n"
+    "  - key: k\n"
+    "    rate_limit:\n"
+    "      unit: hour\n"
+    "      requests_per_unit: 100000000\n"
+)
+# guest: priority 0 = the `_other` shed class (unconfigured traffic and
+# explicit priority-0 domains shed first).  The `hot` value carries a
+# tiny limit so the hot-key sketch sees a genuine repeat offender and
+# the promotion controller has something to promote.
+GUEST_YAML = (
+    "domain: guest\n"
+    "priority: 0\n"
+    "descriptors:\n"
+    "  - key: k\n"
+    "    value: hot\n"
+    "    rate_limit:\n"
+    "      unit: minute\n"
+    "      requests_per_unit: 50\n"
+    "  - key: k\n"
+    "    rate_limit:\n"
+    "      unit: hour\n"
+    "      requests_per_unit: 100000000\n"
+)
+
+
+# ---------------------------------------------------------------------------
+# workload interface
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Event:
+    """One offered request: ``dt`` seconds after the previous event."""
+
+    dt: float
+    domain: str
+    key: str
+    hits: int = 1
+
+
+def _domain_pick(rng, domains: Sequence[tuple]) -> List[str]:
+    names = [d for d, _w in domains]
+    w = np.asarray([w for _d, w in domains], dtype=float)
+    return names, w / w.sum()
+
+
+def _zipf_probs(n_keys: int, alpha: float) -> np.ndarray:
+    ranks = np.arange(1, n_keys + 1, dtype=float)
+    p = ranks ** -alpha
+    return p / p.sum()
+
+
+def workload_zipf(
+    n: int,
+    rate: float,
+    domains: Sequence[tuple] = (("paying", 0.3), ("guest", 0.6), ("stray", 0.1)),
+    n_keys: int = 64,
+    alpha: float = 1.2,
+    hot_share: float = 0.15,
+    seed: int = 7,
+) -> List[Event]:
+    """Poisson arrivals at ``rate`` req/s, Zipf(alpha) key popularity,
+    a fixed domain mix, and ``hot_share`` of guest traffic hammering
+    the single configured low-limit ``hot`` key (the promotion
+    controller's prey)."""
+    rng = np.random.default_rng(seed)
+    names, pw = _domain_pick(rng, domains)
+    dts = rng.exponential(1.0 / rate, n)
+    doms = rng.choice(len(names), n, p=pw)
+    keys = rng.choice(n_keys, n, p=_zipf_probs(n_keys, alpha))
+    hot = rng.random(n) < hot_share
+    out = []
+    for i in range(n):
+        d = names[doms[i]]
+        k = (
+            "hot"
+            if (d in ("guest", "paying") and hot[i])
+            else f"v{keys[i]}"
+        )
+        out.append(Event(float(dts[i]), d, k))
+    return out
+
+
+def workload_burst(
+    n: int,
+    rate: float,
+    burst_factor: float = 6.0,
+    period_s: float = 2.0,
+    duty: float = 0.25,
+    **kw,
+) -> List[Event]:
+    """Square-wave offered rate: ``burst_factor x`` for ``duty`` of
+    every ``period_s``, base rate otherwise (same keys/domains as
+    workload_zipf)."""
+    base = workload_zipf(n, rate, **kw)
+    out, t = [], 0.0
+    lo = rate * (1.0 - duty * burst_factor) / max(1e-9, 1.0 - duty)
+    lo = max(lo, rate * 0.05)
+    for e in base:
+        phase = (t % period_s) / period_s
+        r = rate * burst_factor if phase < duty else lo
+        dt = e.dt * rate / r
+        t += dt
+        out.append(Event(dt, e.domain, e.key, e.hits))
+    return out
+
+
+def workload_diurnal(
+    n: int,
+    rate: float,
+    peak_factor: float = 3.0,
+    period_s: float = 8.0,
+    **kw,
+) -> List[Event]:
+    """Sinusoidal offered rate between ``rate`` and ``peak_factor x``
+    with period ``period_s`` — a compressed diurnal curve."""
+    base = workload_zipf(n, rate, **kw)
+    out, t = [], 0.0
+    for e in base:
+        m = 1.0 + (peak_factor - 1.0) * 0.5 * (
+            1.0 + math.sin(2.0 * math.pi * t / period_s)
+        )
+        dt = e.dt / m
+        t += dt
+        out.append(Event(dt, e.domain, e.key, e.hits))
+    return out
+
+
+def workload_from_flight(
+    path: str, time_scale: float = 1.0, limit: Optional[int] = None
+) -> List[Event]:
+    """Reconstruct a workload from a captured flight ring
+    (``GET /debug/flight?format=jsonl`` — one JSON record per line,
+    oldest first): domains replay verbatim, keys are the recorded
+    stem hashes (same cardinality structure, anonymized values),
+    inter-arrival deltas come from the monotonic stamps scaled by
+    ``time_scale`` (<1 compresses = more load)."""
+    events: List[Event] = []
+    last_ts = None
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            ts = int(rec["ts_ns"])
+            dt = 0.0 if last_ts is None else max(0.0, (ts - last_ts) / 1e9)
+            last_ts = ts
+            events.append(
+                Event(
+                    dt * time_scale,
+                    rec.get("domain", "stray"),
+                    "h" + rec.get("stem_hash", "0"),
+                    max(1, int(rec.get("hits", 1))),
+                )
+            )
+            if limit is not None and len(events) >= limit:
+                break
+    return events
+
+
+def repeat_workload(events: List[Event], times: int) -> List[Event]:
+    """Loop a short recorded ring end-to-end ``times`` times (the
+    join dt is the stream's mean dt, so the rate stays steady)."""
+    if times <= 1 or not events:
+        return list(events)
+    mean_dt = sum(e.dt for e in events) / len(events)
+    out = list(events)
+    for _ in range(times - 1):
+        first = events[0]
+        out.append(Event(mean_dt, first.domain, first.key, first.hits))
+        out.extend(events[1:])
+    return out
+
+
+def mean_rate(events: List[Event]) -> float:
+    total = sum(e.dt for e in events)
+    return len(events) / total if total > 0 else 0.0
+
+
+def scale_to_rate(events: List[Event], rate: float) -> List[Event]:
+    """Rescale inter-arrivals so the stream's mean rate is ``rate``."""
+    cur = mean_rate(events)
+    if cur <= 0 or rate <= 0:
+        return list(events)
+    s = cur / rate
+    return [Event(e.dt * s, e.domain, e.key, e.hits) for e in events]
+
+
+# ---------------------------------------------------------------------------
+# serving stack
+# ---------------------------------------------------------------------------
+
+
+class _Runtime:
+    def __init__(self, files):
+        self._files = files
+
+    def snapshot(self):
+        files = self._files
+
+        class Snap:
+            def keys(self):
+                return sorted(files)
+
+            def get(self, key):
+                return files.get(key, "")
+
+        return Snap()
+
+    def add_update_callback(self, fn):
+        pass
+
+
+@dataclass
+class Stack:
+    service: object
+    cache: object
+    manager: object
+    slo: object
+    flight: object
+    controller: object  # None in the uncontrolled run
+    detectors: object  # None in the uncontrolled run
+
+    def close(self):
+        self.cache.close()
+
+
+def build_stack(
+    controlled: bool,
+    slo_latency_ms: float = 25.0,
+    shed_burn_threshold: float = 14.4,
+    backpressure_tokens: int = 8,
+    queue_threshold: int = 512,
+    backpressure_max_wait_s: float = 0.02,
+) -> Stack:
+    """``queue_threshold`` keeps its production default for the
+    comparison runs: in this harness the dispatcher intake high-water
+    mark is bounded by the driver's worker count (a synchronous closed
+    set), so a threshold below it would trip every tick and ratchet
+    the gate against the PROTECTED tier — the backpressure mechanics
+    get their own injected-trip section instead."""
+    from ratelimit_tpu.backends.engine import CounterEngine
+    from ratelimit_tpu.backends.tpu_cache import TpuRateLimitCache
+    from ratelimit_tpu.observability import (
+        AnomalyDetectors,
+        QueueSaturationDetector,
+        SloEngine,
+        make_flight_recorder,
+    )
+    from ratelimit_tpu.service import RateLimitService
+    from ratelimit_tpu.stats.manager import Manager
+
+    engine = CounterEngine(num_slots=1 << 16, buckets=(8, 32, 128, 1024))
+    cache = TpuRateLimitCache(
+        engine,
+        batch_window_us=200,
+        batch_limit=1024,
+        hotkeys_top_k=64,
+    )
+    manager = Manager()
+    flight = make_flight_recorder(4096)
+    cache.flight = flight
+    slo = SloEngine(
+        manager,
+        target=0.999,
+        window_s=60.0,
+        latency_threshold_ms=slo_latency_ms,
+    )
+    svc = RateLimitService(
+        _Runtime({"config.paying": PAYING_YAML, "config.guest": GUEST_YAML}),
+        cache,
+        manager,
+    )
+    slo.set_domains(svc.get_current_config().domains.keys())
+    svc.slo = slo
+    controller = detectors = None
+    if controlled:
+        from ratelimit_tpu.overload import OverloadController
+
+        controller = OverloadController(
+            slo=slo,
+            hotkeys=cache.hotkeys,
+            shed_enabled=True,
+            shed_burn_threshold=shed_burn_threshold,
+            shed_clear_ratio=0.5,
+            shed_min_requests=20,
+            shed_ewma_alpha=0.6,
+            promote_enabled=True,
+            promote_ttl_s=2.0,
+            promote_over_share=0.5,
+            promote_min_hits=20,
+            backpressure_enabled=True,
+            backpressure_tokens=backpressure_tokens,
+            backpressure_max_wait_s=backpressure_max_wait_s,
+            backpressure_hold_s=5.0,
+        )
+        controller.register_stats(manager.store)
+        controller.set_priorities(svc.get_current_config().priorities)
+        cache.promotion = controller.promotion
+        svc.overload = controller
+        detectors = AnomalyDetectors(
+            manager.store,
+            [
+                QueueSaturationDetector(
+                    cache.queue_hwm_drain, threshold=queue_threshold
+                )
+            ],
+            flight=flight,
+            slo=slo,
+            cooldown_s=1.0,
+            interval_s=0,  # ticked by the driver, not a thread
+            overload=controller,
+        )
+    return Stack(svc, cache, manager, slo, flight, controller, detectors)
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+
+def _make_request(ev: Event):
+    from ratelimit_tpu.api import Descriptor, RateLimitRequest
+
+    return RateLimitRequest(
+        ev.domain, [Descriptor.of(("k", ev.key))], ev.hits
+    )
+
+
+def measure_capacity(stack: Stack, workers: int = 16, seconds: float = 3.0):
+    """Closed-loop throughput probe (the closed_loop_p99.py loop,
+    time-bounded): W workers fire back-to-back over the bench key mix;
+    the completion rate is the stack's capacity on this host."""
+    events = workload_zipf(4096, rate=1000.0, seed=3)
+    counter = itertools.count()
+    done = [0] * workers
+    stop = time.perf_counter() + seconds
+    gate = threading.Event()
+
+    def worker(w):
+        gate.wait()
+        while time.perf_counter() < stop:
+            ev = events[next(counter) % len(events)]
+            try:
+                stack.service.should_rate_limit(_make_request(ev))
+            except Exception:
+                pass
+            done[w] += 1
+
+    threads = [
+        threading.Thread(target=worker, args=(w,)) for w in range(workers)
+    ]
+    for t in threads:
+        t.start()
+    t0 = time.perf_counter()
+    gate.set()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    return sum(done) / elapsed
+
+
+def run_open_loop(
+    stack: Stack,
+    events: List[Event],
+    workers: int = 16,
+    tick_interval_s: float = 0.25,
+    max_wall_s: float = 60.0,
+):
+    """Drive ``events`` on their arrival schedule; latency is measured
+    from the SCHEDULED arrival, so backlog reads as latency (the
+    client's view of a saturated service) instead of silently slowing
+    the offered rate."""
+    from ratelimit_tpu.api import Code
+    from ratelimit_tpu.observability import FLIGHT_CODE_SHED
+
+    sched = np.cumsum([e.dt for e in events])
+    counter = itertools.count()
+    lock = threading.Lock()
+    per_domain: Dict[str, dict] = {}
+    floor_timeline: List[list] = []
+    stop = threading.Event()
+    gate = threading.Event()
+    slo, flight = stack.slo, stack.flight
+
+    half = len(events) // 2
+
+    def domain_bucket(d):
+        b = per_domain.get(d)
+        if b is None:
+            b = per_domain[d] = {
+                "lat": [], "lat_steady": [],
+                "ok": 0, "over_limit": 0, "shed": 0, "errors": 0,
+            }
+        return b
+
+    def worker():
+        gate.wait()
+        t0 = t_zero[0]
+        deadline = t0 + max_wall_s
+        while True:
+            i = next(counter)
+            if i >= len(events):
+                return
+            now = time.perf_counter()
+            if now > deadline:
+                return
+            t_sched = t0 + sched[i]
+            if now < t_sched:
+                time.sleep(t_sched - now)
+            ev = events[i]
+            req = _make_request(ev)
+            try:
+                resp = stack.service.should_rate_limit(req)
+            except Exception:
+                slo.observe_error(ev.domain)
+                with lock:
+                    domain_bucket(ev.domain)["errors"] += 1
+                continue
+            finish = time.perf_counter()
+            ms = (finish - t_sched) * 1e3
+            over = resp.overall_code == Code.OVER_LIMIT
+            shed = resp.shed_reason is not None
+            flight.record(
+                ev.domain,
+                FLIGHT_CODE_SHED if shed else int(resp.overall_code),
+                ev.hits,
+                ms,
+            )
+            slo.observe(ev.domain, over, ms)
+            with lock:
+                b = domain_bucket(ev.domain)
+                b["lat"].append(ms)
+                if i >= half:
+                    # Steady state: the second half of the schedule,
+                    # past the controller's engagement transient — the
+                    # "holds p99 bounded" claim lives here.
+                    b["lat_steady"].append(ms)
+                if shed:
+                    b["shed"] += 1
+                elif over:
+                    b["over_limit"] += 1
+                else:
+                    b["ok"] += 1
+
+    def ticker():
+        gate.wait()
+        while not stop.wait(tick_interval_s):
+            if stack.detectors is not None:
+                stack.detectors.tick()
+            ctrl = stack.controller
+            if ctrl is not None:
+                floor_timeline.append(
+                    [
+                        round(time.perf_counter() - t_zero[0], 2),
+                        ctrl.shed_floor_priority,
+                        1 if ctrl.summary()["backpressure"]["active"] else 0,
+                    ]
+                )
+
+    threads = [threading.Thread(target=worker) for _ in range(workers)]
+    tick_thread = threading.Thread(target=ticker, daemon=True)
+    for t in threads:
+        t.start()
+    tick_thread.start()
+    t_zero = [time.perf_counter()]
+    gate.set()
+    for t in threads:
+        t.join()
+    stop.set()
+    tick_thread.join(timeout=2)
+    wall = time.perf_counter() - t_zero[0]
+    offered_span = float(sched[-1]) if len(sched) else 0.0
+
+    out = {
+        "events": len(events),
+        "offered_rate_rps": round(mean_rate(events), 1),
+        "wall_s": round(wall, 2),
+        # How far the service fell behind the arrival schedule by the
+        # end — the saturation signature (a keeping-up run has ~0).
+        "final_lag_s": round(max(0.0, wall - offered_span), 2),
+        "per_domain": {},
+    }
+    for d, b in sorted(per_domain.items()):
+        lat = b["lat"]
+        steady = b["lat_steady"]
+        served = len(lat)
+        out["per_domain"][d] = {
+            "requests": served + b["errors"],
+            "ok": b["ok"],
+            "over_limit": b["over_limit"],
+            "shed": b["shed"],
+            "errors": b["errors"],
+            "p50_ms": pct([x / 1e3 for x in lat], 50) if lat else None,
+            "p99_ms": pct([x / 1e3 for x in lat], 99) if lat else None,
+            "steady_p50_ms": (
+                pct([x / 1e3 for x in steady], 50) if steady else None
+            ),
+            "steady_p99_ms": (
+                pct([x / 1e3 for x in steady], 99) if steady else None
+            ),
+            "goodput_rps": round(b["ok"] / wall, 1) if wall else 0.0,
+        }
+    if stack.controller is not None:
+        out["floor_timeline"] = floor_timeline
+        out["overload"] = {
+            k: v
+            for k, v in stack.manager.store.counters().items()
+            if k.startswith("ratelimit.overload.")
+        }
+        out["overload"].update(
+            {
+                k: v
+                for k, v in stack.manager.store.gauges().items()
+                if k.startswith("ratelimit.overload.")
+            }
+        )
+        out["controller"] = stack.controller.summary()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# modes
+# ---------------------------------------------------------------------------
+
+
+def record_sample(path: str = SAMPLE_RING, n: int = 512) -> None:
+    """Regenerate the committed sample ring: drive a modest mixed
+    workload through a real stack with the recorder attached, then
+    dump the ring EXACTLY the way /debug/flight?format=jsonl does."""
+    stack = build_stack(controlled=False)
+    try:
+        stack.cache.warmup()
+        events = workload_zipf(n, rate=400.0, seed=11)
+        run_open_loop(stack, events, workers=8, max_wall_s=30.0)
+        records = stack.flight.snapshot_dicts()[::-1]  # oldest first
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            for r in records:
+                f.write(json.dumps(r) + "\n")
+        print(f"wrote {path} ({len(records)} records)")
+    finally:
+        stack.close()
+
+
+def overload_comparison(
+    factor: float = 2.0,
+    duration_s: float = 12.0,
+    workers: int = 16,
+    workload: Callable = workload_zipf,
+    workload_name: str = "zipf",
+    capacity_probe_s: float = 3.0,
+):
+    """The headline measurement: controlled vs uncontrolled at
+    ``factor x`` the measured closed-loop capacity."""
+    probe = build_stack(controlled=False)
+    try:
+        probe.cache.warmup()
+        measure_capacity(probe, workers=workers, seconds=0.5)  # jit warm
+        capacity = measure_capacity(
+            probe, workers=workers, seconds=capacity_probe_s
+        )
+    finally:
+        probe.close()
+    rate = capacity * factor
+    n = int(rate * duration_s)
+    events = workload(n, rate)
+
+    runs = {}
+    for name, controlled in (("uncontrolled", False), ("controlled", True)):
+        stack = build_stack(controlled=controlled)
+        try:
+            stack.cache.warmup()
+            measure_capacity(stack, workers=workers, seconds=0.5)  # warm
+            runs[name] = run_open_loop(
+                stack,
+                events,
+                workers=workers,
+                max_wall_s=duration_s * 3.0,
+            )
+        finally:
+            stack.close()
+
+    c = runs["controlled"]["per_domain"].get("paying", {})
+    u = runs["uncontrolled"]["per_domain"].get("paying", {})
+    verdict = {
+        "paying_p99_controlled_ms": c.get("p99_ms"),
+        "paying_p99_uncontrolled_ms": u.get("p99_ms"),
+        # Steady state (second half of the schedule, past the
+        # controller's engagement transient): the bounded-vs-saturated
+        # contrast proper.  The uncontrolled backlog only GROWS, so
+        # its steady p99 exceeds its full-run p99; the controlled one
+        # collapses once the floor engages.
+        "paying_steady_p99_controlled_ms": c.get("steady_p99_ms"),
+        "paying_steady_p99_uncontrolled_ms": u.get("steady_p99_ms"),
+        "paying_goodput_controlled_rps": c.get("goodput_rps"),
+        "paying_goodput_uncontrolled_rps": u.get("goodput_rps"),
+        "uncontrolled_final_lag_s": runs["uncontrolled"]["final_lag_s"],
+        "controlled_final_lag_s": runs["controlled"]["final_lag_s"],
+        "controlled_shed_total": runs["controlled"]["overload"].get(
+            "ratelimit.overload.shed_total", 0
+        ),
+        "paying_p99_bounded": bool(
+            c.get("steady_p99_ms") is not None
+            and u.get("steady_p99_ms") is not None
+            and c["steady_p99_ms"] < u["steady_p99_ms"]
+        ),
+    }
+    return {
+        "workload": workload_name,
+        "capacity_probe": {
+            "closed_loop_rate_rps": round(capacity, 1),
+            "workers": workers,
+            "seconds": capacity_probe_s,
+        },
+        "offered": {
+            "factor": factor,
+            "rate_rps": round(rate, 1),
+            "events": n,
+            "duration_s": duration_s,
+        },
+        "runs": runs,
+        "verdict": verdict,
+    }
+
+
+def backpressure_demo(workers: int = 16, seconds: float = 3.0):
+    """The admission-gate mechanics, demonstrated with an INJECTED
+    detector trip (clearly labeled as such): in this harness the
+    dispatcher queue cannot legitimately saturate — the driver's
+    synchronous worker set bounds intake depth — so the gate is
+    engaged by hand and the measurement shows the graceful-degradation
+    contract: a starved gate sheds after a BOUNDED wait instead of
+    queueing unboundedly, admitted traffic keeps flowing, and the gate
+    releases after the hold."""
+    stack = build_stack(
+        controlled=True,
+        backpressure_tokens=2,
+        backpressure_max_wait_s=0.005,
+    )
+    try:
+        stack.cache.warmup()
+        measure_capacity(stack, workers=workers, seconds=0.5)  # warm
+        open_rate = measure_capacity(stack, workers=workers, seconds=1.0)
+        ctrl = stack.controller
+        ctrl.on_detector_trip(
+            "queue_saturation", "injected: replay.py backpressure demo"
+        )
+        gated_rate = measure_capacity(stack, workers=workers, seconds=seconds)
+        engaged = ctrl.summary()["backpressure"]
+        counters = {
+            k: v
+            for k, v in stack.manager.store.counters().items()
+            if "backpressure" in k or k.endswith("shed_total")
+        }
+        time.sleep(5.2)  # BACKPRESSURE_HOLD_S in build_stack is 5.0
+        ctrl.tick()
+        released = not ctrl.summary()["backpressure"]["active"]
+    finally:
+        stack.close()
+    return {
+        "note": (
+            "gate engaged by an injected queue_saturation trip; "
+            "tokens=2 vs 16 workers, bounded wait 5ms then shed"
+        ),
+        "ungated_closed_loop_rps": round(open_rate, 1),
+        "gated_closed_loop_rps_including_sheds": round(gated_rate, 1),
+        "engaged_state": engaged,
+        "counters": counters,
+        "released_after_hold": released,
+    }
+
+
+def smoke() -> int:
+    """CI smoke (``make replay-smoke``): tiny committed ring ->
+    replay at forced overload -> assert shed counters move and the
+    artifact is well-formed."""
+    base = workload_from_flight(SAMPLE_RING)
+    if not base:
+        print("FAIL: sample ring is empty or unreadable:", SAMPLE_RING)
+        return 1
+    stack = build_stack(controlled=True, shed_burn_threshold=8.0)
+    try:
+        stack.cache.warmup()
+        measure_capacity(stack, workers=8, seconds=0.5)  # jit warm
+        capacity = measure_capacity(stack, workers=8, seconds=1.0)
+        rate = max(200.0, capacity * 3.0)
+        need = int(rate * 4.0)
+        events = scale_to_rate(
+            repeat_workload(base, max(1, need // len(base) + 1))[:need], rate
+        )
+        result = run_open_loop(
+            stack, events, workers=8, tick_interval_s=0.2, max_wall_s=20.0
+        )
+    finally:
+        stack.close()
+
+    failures = []
+    shed_total = result["overload"].get("ratelimit.overload.shed_total", 0)
+    if shed_total <= 0:
+        failures.append("shed counters did not move under forced overload")
+    shed_counts = sum(
+        v
+        for k, v in result["overload"].items()
+        if ".shed." in k and k.endswith(".slo_burn")
+    )
+    if shed_counts <= 0:
+        failures.append("per-domain shed.slo_burn counters did not move")
+    ring_sheds = sum(
+        1 for r in stack.flight.snapshot_dicts() if r.get("shed")
+    )
+    if ring_sheds <= 0:
+        failures.append("no shed-coded flight records in the ring")
+    for d, row in result["per_domain"].items():
+        if row["requests"] > 0 and row["p99_ms"] is None:
+            failures.append(f"malformed p99 for domain {d}")
+        if row["p99_ms"] is not None and not (
+            isinstance(row["p99_ms"], float) and row["p99_ms"] >= 0
+        ):
+            failures.append(f"non-numeric p99 for domain {d}")
+    if "floor_timeline" not in result:
+        failures.append("controlled run missing floor_timeline")
+
+    print(
+        json.dumps(
+            {
+                "smoke": True,
+                "ok": not failures,
+                "ring_events": len(base),
+                "replayed": result["events"],
+                "offered_rate_rps": result["offered_rate_rps"],
+                "shed_total": shed_total,
+                "ring_shed_records": ring_sheds,
+                "paying_p99_ms": result["per_domain"]
+                .get("paying", {})
+                .get("p99_ms"),
+                "failures": failures,
+            }
+        )
+    )
+    return 1 if failures else 0
+
+
+def main() -> None:
+    if "--record" in sys.argv:
+        record_sample()
+        return
+    if "--smoke" in sys.argv:
+        sys.exit(smoke())
+
+    out = {
+        "harness": (
+            "open-loop replay at factor x measured closed-loop capacity; "
+            "latency measured from SCHEDULED arrival so backlog reads as "
+            "latency; controlled run = shed+promotion+backpressure "
+            "controllers live (overload/controller.py), ticked at 250ms; "
+            "uncontrolled run = same stack, no controller"
+        ),
+        "host": "1-core container, CPU XLA platform",
+        "comparison": overload_comparison(),
+        "backpressure_demo": backpressure_demo(),
+    }
+    # Scenario-suite smoke points: the same driver over the other
+    # generator shapes and the committed recorded ring (short runs —
+    # these document the interface every later PR reuses, the headline
+    # claim lives in `comparison`).
+    ring = workload_from_flight(SAMPLE_RING)
+    out["scenario_suite"] = {
+        "zipf": {"events": 2048, "mean_rate_rps": round(mean_rate(workload_zipf(2048, 500.0)), 1)},
+        "burst": {"events": 2048, "mean_rate_rps": round(mean_rate(workload_burst(2048, 500.0)), 1)},
+        "diurnal": {"events": 2048, "mean_rate_rps": round(mean_rate(workload_diurnal(2048, 500.0)), 1)},
+        "flight_replay": {
+            "source": os.path.relpath(SAMPLE_RING, os.path.dirname(RESULTS)),
+            "events": len(ring),
+            "recorded_mean_rate_rps": round(mean_rate(ring), 1),
+            "domains": sorted({e.domain for e in ring}),
+        },
+    }
+    os.makedirs(os.path.dirname(RESULTS), exist_ok=True)
+    with open(RESULTS, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out["comparison"]["verdict"], indent=1))
+    print("wrote", RESULTS)
+
+
+if __name__ == "__main__":
+    main()

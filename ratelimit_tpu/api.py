@@ -115,8 +115,17 @@ class HeaderValue:
 
 @dataclass
 class RateLimitResponse:
-    """RateLimitResponse: aggregate code + per-descriptor statuses."""
+    """RateLimitResponse: aggregate code + per-descriptor statuses.
+
+    ``shed_reason`` is process-internal (never serialized): non-None
+    when the overload controller refused the request before any
+    backend work (overload/controller.py).  The wire code is a plain
+    OVER_LIMIT — the Envoy protocol has no richer vocabulary — but the
+    transports stamp flight records with the distinguishable
+    FLIGHT_CODE_SHED so the ring separates "counted out" from "load
+    shed"."""
 
     overall_code: Code = Code.UNKNOWN
     statuses: list = field(default_factory=list)
     response_headers_to_add: list = field(default_factory=list)
+    shed_reason: Optional[str] = None

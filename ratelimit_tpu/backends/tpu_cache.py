@@ -177,6 +177,14 @@ class TpuRateLimitCache:
         # the ring record after serialize.  None = disabled (the
         # per-request cost is one attribute load + branch).
         self.flight = None
+        # Hot-key promotion cache (overload/controller.py), attached
+        # by the runner when OVERLOAD_PROMOTE_ENABLED: stems the
+        # sketch marked repeat offenders carry a short-TTL host-side
+        # OVER_LIMIT decision checked in _prepare_resolved, so they
+        # skip the device entirely (the reference's freecache
+        # OVER_LIMIT cache, sketch-driven).  None = disabled (one
+        # attribute load + branch per descriptor).
+        self.promotion = None
         self.expiration_jitter_max_seconds = int(expiration_jitter_max_seconds)
         self.jitter_rand = jitter_rand or random.Random()
         # Liveness backstop for dispatcher waits; generous because the
@@ -372,6 +380,11 @@ class TpuRateLimitCache:
             add_enc = enc0.append
             add_tpl = tp0.append
         local_cache = self.local_cache
+        promotion = self.promotion
+        # Promotion miss fast path: membership on the raw entries dict
+        # (one GIL-atomic op per descriptor); only HITS pay the
+        # contains() call (expiry check + counting).
+        promo_entries = promotion.entries if promotion is not None else None
         resolve = resolver.resolve
         # Hot-key sketch feed: one counter bump per limited descriptor
         # on the handle pinned to its ResolvedDescriptor; track() (the
@@ -468,6 +481,18 @@ class TpuRateLimitCache:
                 acc[0].append(i)
                 acc[1].append(ws.algo_key_bytes)
                 acc[2].append(ws.algo_template_bytes)
+                continue
+            if (
+                promo_entries is not None
+                and rd.stem in promo_entries
+                and promotion.contains(rd.stem)
+            ):
+                # Hot-key promotion (overload/controller.py): the
+                # sketch marked this stem a repeat offender; serve the
+                # short-TTL host decision and skip the device.  Shadow
+                # rules stay non-enforcing here exactly like the host
+                # over-limit cache below.
+                categories[i] = _CAT_SKIP if rule.shadow_mode else _CAT_LOCAL
                 continue
             if local_cache is not None and local_cache.contains(key.key):
                 # Shadow rules skip the counter but never short-circuit

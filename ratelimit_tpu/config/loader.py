@@ -35,7 +35,9 @@ from ..stats.manager import Manager, RateLimitStats
 
 # Whitelisted YAML keys (reference config_impl.go:49-59; `algorithm`
 # and `shadow` are the pluggable-limiter extension — see
-# docs/ALGORITHMS.md).
+# docs/ALGORITHMS.md; `priority` is the domain-level shed-ordering
+# key the overload controller consumes — see docs/OBSERVABILITY.md
+# "Overload control").
 VALID_KEYS = frozenset(
     {
         "domain",
@@ -49,8 +51,15 @@ VALID_KEYS = frozenset(
         "shadow_mode",
         "algorithm",
         "shadow",
+        "priority",
     }
 )
+
+#: Priority assumed for configured domains that carry no ``priority:``
+#: key — above the ``_other`` class (0 — unconfigured traffic and
+#: explicit ``priority: 0`` domains), so plain configs shed stranger
+#: traffic before their own (overload/controller.py).
+DEFAULT_DOMAIN_PRIORITY = 1
 
 
 class ConfigError(Exception):
@@ -176,6 +185,10 @@ class RateLimitConfig:
         self._domains: Dict[str, _Node] = {}
         self._stats_manager = stats_manager
         self.generation = next(_GENERATION)
+        # Domain -> shed priority (the overload controller's level
+        # ladder; overload/controller.py).  Every loaded domain has an
+        # entry — explicit ``priority:`` or DEFAULT_DOMAIN_PRIORITY.
+        self.priorities: Dict[str, int] = {}
 
     # -- loading ---------------------------------------------------------
 
@@ -199,9 +212,26 @@ class RateLimitConfig:
         if domain in self._domains:
             raise _error(file, f"duplicate domain '{domain}' in config file")
 
+        priority = raw.get("priority")
+        if priority is None:
+            priority = DEFAULT_DOMAIN_PRIORITY
+        elif (
+            isinstance(priority, bool)
+            or not isinstance(priority, int)
+            or priority < 0
+        ):
+            # bool is an int subclass — `priority: true` must not
+            # silently become priority 1.
+            raise _error(
+                file,
+                "error loading config file: priority must be a "
+                f"non-negative integer, got {priority!r}",
+            )
+
         root = _Node()
         self._load_descriptors(file, root, domain + ".", raw.get("descriptors") or [])
         self._domains[domain] = root
+        self.priorities[domain] = priority
 
     def _load_descriptors(
         self, file: ConfigFile, node: _Node, parent_key: str, descriptors: Sequence[dict]
@@ -211,6 +241,15 @@ class RateLimitConfig:
         if not isinstance(descriptors, list):
             raise _error(file, "error loading config file: descriptors must be a list")
         for desc in descriptors:
+            if "priority" in desc:
+                # Shed ordering is a DOMAIN property (the controller
+                # sheds whole domains, lowest level first); a
+                # per-descriptor priority would silently do nothing.
+                raise _error(
+                    file,
+                    "priority is a domain-level key (shed ordering); "
+                    "it cannot appear on a descriptor",
+                )
             key = _as_str(file, desc.get("key"), "key")
             if key == "":
                 raise _error(file, "descriptor has empty key")

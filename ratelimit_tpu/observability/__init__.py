@@ -23,7 +23,12 @@ from .detectors import (
     OverLimitSurgeDetector,
     QueueSaturationDetector,
 )
-from .flight import FLIGHT_DTYPE, FlightRecorder, make_flight_recorder
+from .flight import (
+    FLIGHT_CODE_SHED,
+    FLIGHT_DTYPE,
+    FlightRecorder,
+    make_flight_recorder,
+)
 from .hotkeys import HotKeyEntry, HotKeySketch
 from .slo import SloEngine
 from .trace import (
@@ -47,6 +52,7 @@ __all__ = [
     "Detector",
     "ErrorRateDetector",
     "Ewma",
+    "FLIGHT_CODE_SHED",
     "FLIGHT_DTYPE",
     "FinishedTrace",
     "FlightRecorder",

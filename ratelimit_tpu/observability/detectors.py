@@ -267,12 +267,21 @@ class AnomalyDetectors:
         interval_s: float = 5.0,
         cooldown_s: float = 60.0,
         clock: Optional[MonotonicClock] = None,
+        overload=None,
     ):
+        """``overload`` (overload/controller.py), when wired, rides
+        the sampler: every TRIPPED detector evaluation is forwarded to
+        ``overload.on_detector_trip`` (before cooldown gating — the
+        backpressure hold must keep extending while the condition
+        persists, even when no new incident is captured), and
+        ``overload.tick()`` runs once per sampler tick after the
+        detectors, so control actions use this tick's signals."""
         self.store = store
         self.detectors = list(detectors)
         self.flight = flight
         self.tracer = tracer
         self.slo = slo
+        self.overload = overload
         self.incident_dir = incident_dir
         self.incident_max = max(1, int(incident_max))
         self.interval_s = float(interval_s)
@@ -310,11 +319,15 @@ class AnomalyDetectors:
                 continue
             if reason is None:
                 continue
+            if self.overload is not None:
+                self.overload.on_detector_trip(d.name, reason)
             last = self._last_trip.get(d.name)
             if last is not None and now - last < self.cooldown_s:
                 continue
             self._last_trip[d.name] = now
             captured.append(self._capture(d.name, reason))
+        if self.overload is not None:
+            self.overload.tick()
         return captured
 
     def _capture(self, detector: str, reason: str) -> dict:

@@ -95,6 +95,14 @@ LATENCY_BOUNDS_MS = Histogram.DEFAULT_BOUNDS
 #: the id map unboundedly; overflow domains share id 0 ("_other").
 MAX_DOMAINS = 256
 
+#: Flight-record ``code`` for a decision the OVERLOAD CONTROLLER shed
+#: (overload/controller.py): the wire response is a plain OVER_LIMIT
+#: (the Envoy protocol has no richer vocabulary), but the ring must
+#: distinguish "the limiter counted you out" from "the service refused
+#: to do the work" — replay and incident forensics depend on it.
+#: Outside the api.Code range (0..2) on purpose.
+FLIGHT_CODE_SHED = 8
+
 
 class _Note(threading.local):
     """Per-thread (stem_hash, lane) deposit from the backend's request
@@ -279,6 +287,11 @@ class FlightRecorder:
                 # would-be code + its algorithm-table name.
                 d["shadow_code"] = code2
                 d["shadow_algorithm"] = _ALGO_NAMES.get(algo, str(algo))
+            if code == FLIGHT_CODE_SHED:
+                # Overload-controller shed (overload/controller.py):
+                # annotate so readers never mistake the sentinel for a
+                # protocol code.
+                d["shed"] = True
             out.append(d)
         return out
 

@@ -207,6 +207,7 @@ class Runner:
         self.flight = None
         self.slo = None
         self.detectors = None
+        self.overload = None
 
     # -- lifecycle (runner.go:76-143) -----------------------------------
 
@@ -310,6 +311,41 @@ class Runner:
             window_s=s.slo_window_s,
             latency_threshold_ms=s.slo_latency_ms,
         )
+
+        # Overload controller (overload/controller.py): built ONLY
+        # when some OVERLOAD_* setting asks for it — the defaults-off
+        # serving path carries no controller object at all, so
+        # decisions stay byte-identical to a build without the layer.
+        if (
+            s.overload_shed_enabled
+            or s.overload_promote_enabled
+            or s.overload_backpressure_enabled
+        ):
+            from .overload import OverloadController
+
+            self.overload = OverloadController(
+                slo=self.slo,
+                hotkeys=getattr(self.cache, "hotkeys", None),
+                shed_enabled=s.overload_shed_enabled,
+                shed_burn_threshold=s.shed_burn_threshold,
+                shed_clear_ratio=s.shed_clear_ratio,
+                shed_min_requests=s.shed_min_requests,
+                promote_enabled=s.overload_promote_enabled,
+                promote_ttl_s=s.promote_ttl_s,
+                promote_over_share=s.promote_over_share,
+                promote_min_hits=s.promote_min_hits,
+                promote_capacity=s.promote_capacity,
+                backpressure_enabled=s.overload_backpressure_enabled,
+                backpressure_tokens=s.backpressure_tokens,
+                backpressure_max_wait_s=s.backpressure_max_wait_s,
+                backpressure_hold_s=s.backpressure_hold_s,
+            )
+            self.overload.register_stats(store)
+            if self.overload.promotion is not None and hasattr(
+                self.cache, "promotion"
+            ):
+                self.cache.promotion = self.overload.promotion
+
         if s.tpu_warmup and hasattr(self.cache, "warmup"):
             logger.warning("warming up kernel shapes (TPU_WARMUP=true)...")
             self.cache.warmup()
@@ -348,11 +384,15 @@ class Runner:
         )
         # SLO domains follow the config: attach the engine, then adopt
         # the already-loaded snapshot (construction above reloaded
-        # before the attribute existed).
+        # before the attribute existed).  The overload controller's
+        # priority ladder follows the same pattern.
         self.service.slo = self.slo
+        self.service.overload = self.overload
         config = self.service.get_current_config()
         if config is not None:
             self.slo.set_domains(config.domains.keys())
+            if self.overload is not None:
+                self.overload.set_priorities(config.priorities)
         self.runtime.start()
 
         # Anomaly detectors + incident capture (detectors.py).  Always
@@ -387,6 +427,7 @@ class Runner:
             incident_max=s.incident_max,
             interval_s=s.anomaly_interval_s,
             cooldown_s=s.anomaly_cooldown_s,
+            overload=self.overload,
         )
         self.detectors.register_stats(store)
         self.detectors.start()
@@ -446,6 +487,8 @@ class Runner:
             profiling_enabled=s.debug_profiling,
             detectors=self.detectors,
             slo=self.slo,
+            overload=self.overload,
+            flight=self.flight,
         )
         add_healthcheck(self.debug_server, self.health)
         self.debug_server.start()

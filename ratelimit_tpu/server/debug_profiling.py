@@ -244,6 +244,14 @@ ENDPOINT_BLURBS = {
     "/debug/hotkeys": "top-K hottest descriptor stems (JSON)",
     "/debug/incidents": "captured anomaly incident reports (JSON)",
     "/debug/slo": "per-domain SLI / error-budget burn summary (JSON)",
+    "/debug/overload": (
+        "live overload-control state: shed floor, burns, promotion "
+        "set, backpressure gate (JSON)"
+    ),
+    "/debug/flight": (
+        "flight-ring capture ?format=jsonl|json — replay harness "
+        "input (DEBUG_PROFILING=1)"
+    ),
     "/debug/threadz": "all-thread stack dump",
     "/debug/profile": (
         "statistical CPU profile ?seconds=N (DEBUG_PROFILING=1)"

@@ -97,6 +97,7 @@ def _ratelimit_handler(
 ):
     serialize = rls_pb2.RateLimitResponse.SerializeToString
     from ..api import Code as _Code
+    from ..observability import FLIGHT_CODE_SHED as _SHED
 
     def should_rate_limit(request_pb, context):
         start = time.perf_counter()
@@ -159,9 +160,16 @@ def _ratelimit_handler(
                 total_ms = (t_serialized - start) * 1e3
                 over = response.overall_code == _Code.OVER_LIMIT
                 if flight is not None:
+                    # Overload sheds carry their own ring code: the
+                    # wire says OVER_LIMIT, the black box must say WHY
+                    # (overload/controller.py).
                     flight.record(
                         request.domain,
-                        int(response.overall_code),
+                        (
+                            _SHED
+                            if response.shed_reason is not None
+                            else int(response.overall_code)
+                        ),
                         request.hits_addend,
                         total_ms,
                     )

@@ -154,6 +154,7 @@ def add_json_handler(server: HttpServer, service, flight=None, slo=None) -> None
     import time as _time
 
     from ..api import Code as _Code
+    from ..observability import FLIGHT_CODE_SHED as _SHED
 
     def handle(h) -> None:
         t_start = _time.perf_counter()
@@ -205,9 +206,15 @@ def add_json_handler(server: HttpServer, service, flight=None, slo=None) -> None
                     total_ms = (_time.perf_counter() - t_start) * 1e3
                     over = response.overall_code == _Code.OVER_LIMIT
                     if flight is not None:
+                        # Sheds carry the distinguishable ring code
+                        # (grpc handler twin; overload/controller.py).
                         flight.record(
                             request.domain,
-                            int(response.overall_code),
+                            (
+                                _SHED
+                                if response.shed_reason is not None
+                                else int(response.overall_code)
+                            ),
                             request.hits_addend,
                             total_ms,
                         )
@@ -240,12 +247,15 @@ def add_debug_routes(
     profiling_enabled: bool = False,
     detectors=None,
     slo=None,
+    overload=None,
+    flight=None,
 ) -> None:
     """/stats, /rlconfig, /metrics, /debug/* (server_impl.go:254-261,
     runner.go:117-124).  ``profiling_enabled`` (the DEBUG_PROFILING
-    setting) opens the capture endpoints in debug_profiling.py;
-    ``detectors``/``slo`` (observability/) open /debug/incidents and
-    /debug/slo."""
+    setting) opens the capture endpoints in debug_profiling.py AND the
+    flight-ring capture at /debug/flight; ``detectors``/``slo``
+    (observability/) open /debug/incidents and /debug/slo;
+    ``overload`` (overload/controller.py) opens /debug/overload."""
 
     def stats(h) -> None:
         lines = []
@@ -351,8 +361,65 @@ def add_debug_routes(
             content_type="application/json",
         )
 
+    def overload_view(h) -> None:
+        # Overload-control zPage (overload/controller.py): the live
+        # shed floor, per-domain burns, promotion set and backpressure
+        # gate — "shedding is active, is it correct?" starts here
+        # (docs/INCIDENT_RUNBOOK.md).
+        if overload is None:
+            h._reply(
+                404,
+                b"overload control disabled (no OVERLOAD_* setting "
+                b"enabled)\n",
+            )
+            return
+        h._reply(
+            200,
+            json.dumps(overload.summary()).encode(),
+            content_type="application/json",
+        )
+
+    def flight_dump(h) -> None:
+        # Flight-ring capture (observability/flight.py): the replay
+        # harness's input feed (benchmarks/replay.py) — pull the last
+        # FLIGHT_RECORDER_SIZE decisions off a live replica as JSONL.
+        # Gated like /debug/profile: dumping per-request decision
+        # evidence is an operator action, not a default-open surface.
+        if not profiling_enabled:
+            h._reply(
+                403,
+                b"flight-ring capture is disabled; start the server "
+                b"with DEBUG_PROFILING=1 to enable /debug/flight\n",
+            )
+            return
+        if flight is None:
+            h._reply(
+                404, b"flight recorder disabled (FLIGHT_RECORDER_SIZE=0)\n"
+            )
+            return
+        from urllib.parse import parse_qs, urlsplit
+
+        qs = parse_qs(urlsplit(h.path).query)
+        fmt = qs.get("format", ["jsonl"])[0]
+        # Oldest first: replay consumes inter-arrival deltas in
+        # chronological order (snapshot_dicts returns newest first).
+        records = flight.snapshot_dicts()[::-1]
+        if fmt == "json":
+            h._reply(
+                200,
+                json.dumps(
+                    {"capacity": flight.size, "records": records}
+                ).encode(),
+                content_type="application/json",
+            )
+            return
+        body = "".join(json.dumps(r) + "\n" for r in records)
+        h._reply(200, body.encode(), content_type="application/x-ndjson")
+
     server.add_route("GET", "/debug/incidents", incidents)
     server.add_route("GET", "/debug/slo", slo_summary)
+    server.add_route("GET", "/debug/overload", overload_view)
+    server.add_route("GET", "/debug/flight", flight_dump)
 
     if service is not None:
 

@@ -48,6 +48,12 @@ class RateLimitService:
     # runner after construction; reload_config feeds it the configured
     # domain set so per-domain metric families stay bounded by config.
     slo = None
+    # Overload controller (overload/controller.py), attached by the
+    # runner when any OVERLOAD_* setting is on; reload_config feeds it
+    # the configured domain -> priority map.  None (the default) keeps
+    # the request path byte-identical to a build without the control
+    # layer.
+    overload = None
 
     def __init__(
         self,
@@ -121,6 +127,9 @@ class RateLimitService:
             # Adopt the new configured domain set BEFORE the swap so a
             # request racing the reload finds its domain interned.
             self.slo.set_domains(new_config.domains.keys())
+        if self.overload is not None:
+            # Same ordering contract for the shed-priority ladder.
+            self.overload.set_priorities(new_config.priorities)
         with self._config_lock:
             self._config = new_config
             if self._settings_reloader is not None:
@@ -168,6 +177,36 @@ class RateLimitService:
         if len(request.descriptors) == 0:
             raise ServiceError("rate limit descriptor list must not be empty")
 
+        # Overload admission control (overload/controller.py): shed
+        # BEFORE any backend work — the whole point is not doing it —
+        # and release the backpressure gate (when one admitted us)
+        # after the backend leg completes.  Shed responses are
+        # deliberately blunt: OVER_LIMIT on every descriptor, no
+        # headers, and global_shadow_mode does NOT soften them (shadow
+        # mode is about not ENFORCING limits; shedding is the service
+        # protecting itself — suppressing it would readmit the load
+        # the controller just decided it cannot carry).
+        ov = self.overload
+        if ov is None:
+            return self._decide(request)
+        shed_reason, gate = ov.admit(request.domain)
+        if shed_reason is not None:
+            response = RateLimitResponse()
+            response.overall_code = Code.OVER_LIMIT
+            response.shed_reason = shed_reason
+            response.statuses = [
+                DescriptorStatus(code=Code.OVER_LIMIT)
+                for _ in request.descriptors
+            ]
+            return response
+        if gate is None:
+            return self._decide(request)
+        try:
+            return self._decide(request)
+        finally:
+            gate.release()
+
+    def _decide(self, request: RateLimitRequest) -> RateLimitResponse:
         if self._resolver is not None:
             # Descriptor-resolution fast path: rule lookup, key
             # generation and lane packing fuse into ONE pass inside

@@ -271,6 +271,43 @@ class Settings:
     slo_window_s: float = 3600.0
     slo_latency_ms: float = 50.0
 
+    # Overload control (overload/controller.py; docs/OBSERVABILITY.md
+    # "Overload control").  ALL THREE controllers are off by default:
+    # with every OVERLOAD_* knob at its default the runner builds no
+    # controller and decisions are byte-identical to a build without
+    # the layer.  Ticks ride the anomaly sampler, so acting (not just
+    # sensing) needs ANOMALY_INTERVAL_S > 0.
+    #
+    # SLO-burn load shedding: when the EWMA-smoothed per-tick error-
+    # budget burn of the still-admitted traffic exceeds
+    # SHED_BURN_THRESHOLD, the shed floor rises one configured
+    # priority level per tick (domains below the floor answer
+    # OVER_LIMIT with no backend work; `priority:` in the limit YAML,
+    # unconfigured domains shed first); it steps back down once burn
+    # falls below threshold * SHED_CLEAR_RATIO (hysteresis).
+    overload_shed_enabled: bool = False
+    shed_burn_threshold: float = 14.4
+    shed_clear_ratio: float = 0.5
+    shed_min_requests: int = 20
+    # Hot-key promotion: stems whose per-tick over-limit share (from
+    # the hot-key sketch; needs HOTKEYS_TOP_K > 0) reaches
+    # PROMOTE_OVER_SHARE across at least PROMOTE_MIN_HITS hits get a
+    # PROMOTE_TTL_S host-side OVER_LIMIT decision and skip the device.
+    overload_promote_enabled: bool = False
+    promote_ttl_s: float = 2.0
+    promote_over_share: float = 0.5
+    promote_min_hits: int = 64
+    promote_capacity: int = 1024
+    # Detector-triggered backpressure: queue-saturation/latency-spike
+    # trips gate admission behind BACKPRESSURE_TOKENS concurrent
+    # permits; a request waits up to BACKPRESSURE_MAX_WAIT_S for one,
+    # then sheds.  Repeat trips halve the tokens (ratchet); the gate
+    # releases BACKPRESSURE_HOLD_S after the last trip.
+    overload_backpressure_enabled: bool = False
+    backpressure_tokens: int = 64
+    backpressure_max_wait_s: float = 0.05
+    backpressure_hold_s: float = 30.0
+
     # Request tracing (observability/trace.py; docs/OBSERVABILITY.md).
     # Head-sampling probability for traces with no inbound traceparent
     # (an inbound sampled flag always wins); 0.0 = only errors and
@@ -370,6 +407,21 @@ def new_settings() -> Settings:
         slo_target=_env_float("SLO_TARGET", 0.999),
         slo_window_s=_env_float("SLO_WINDOW_S", 3600.0),
         slo_latency_ms=_env_float("SLO_LATENCY_MS", 50.0),
+        overload_shed_enabled=_env_bool("OVERLOAD_SHED_ENABLED", False),
+        shed_burn_threshold=_env_float("SHED_BURN_THRESHOLD", 14.4),
+        shed_clear_ratio=_env_float("SHED_CLEAR_RATIO", 0.5),
+        shed_min_requests=_env_int("SHED_MIN_REQUESTS", 20),
+        overload_promote_enabled=_env_bool("OVERLOAD_PROMOTE_ENABLED", False),
+        promote_ttl_s=_env_float("PROMOTE_TTL_S", 2.0),
+        promote_over_share=_env_float("PROMOTE_OVER_SHARE", 0.5),
+        promote_min_hits=_env_int("PROMOTE_MIN_HITS", 64),
+        promote_capacity=_env_int("PROMOTE_CAPACITY", 1024),
+        overload_backpressure_enabled=_env_bool(
+            "OVERLOAD_BACKPRESSURE_ENABLED", False
+        ),
+        backpressure_tokens=_env_int("BACKPRESSURE_TOKENS", 64),
+        backpressure_max_wait_s=_env_float("BACKPRESSURE_MAX_WAIT_S", 0.05),
+        backpressure_hold_s=_env_float("BACKPRESSURE_HOLD_S", 30.0),
         trace_sample_rate=_env_float("TRACE_SAMPLE_RATE", 0.0),
         trace_sample_errors=_env_bool("TRACE_SAMPLE_ERRORS", True),
         trace_ring_size=_env_int("TRACE_RING_SIZE", 256),
