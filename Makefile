@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: all test lint sanitize bench bench-host replay-smoke protos native serve check_config smoke_client metrics-smoke docker_image e2e e2e-local ci clean
+.PHONY: all test lint sanitize bench bench-host replay-smoke cluster-smoke protos native serve check_config smoke_client metrics-smoke docker_image e2e e2e-local ci clean
 
 # C++ hot-path library: slot table + decide kernel (auto-built on
 # first import too; this forces it).  Goes through the Python builder
@@ -56,6 +56,14 @@ bench-host:
 replay-smoke:
 	$(CPU_ENV) PALLAS_AXON_POOL_IPS= $(PY) benchmarks/replay.py --smoke
 
+# Elastic-cluster smoke: two in-process replicas behind the proxy's
+# RouterHolder; kill one (ejection + failover), kill both (degraded
+# CLUSTER_FAILURE_MODE answer), then join a third with counter
+# handoff over the real /debug/cluster admin endpoints and assert the
+# moved key's window did NOT restart (docs/MULTI_REPLICA.md).
+cluster-smoke:
+	$(CPU_ENV) PALLAS_AXON_POOL_IPS= $(PY) scripts/cluster_smoke.py
+
 # Regenerate committed protobuf classes after editing protos/.
 protos:
 	sh scripts/gen_protos.sh
@@ -102,7 +110,7 @@ e2e-local:
 # The full CI recipe (.github/workflows/ci.yaml runs exactly this):
 # native build, tests, offline config validation, black-box e2e,
 # bench smoke on the CPU platform.
-ci: lint native test sanitize check_config metrics-smoke bench-host replay-smoke e2e-local
+ci: lint native test sanitize check_config metrics-smoke bench-host replay-smoke cluster-smoke e2e-local
 	$(CPU_ENV) PALLAS_AXON_POOL_IPS= $(PY) bench.py
 
 clean:

@@ -1,0 +1,355 @@
+"""Membership-churn e2e: kill + add a replica under sustained replay
+load, with and without counter handoff.
+
+The scenario the elastic cluster tier must survive (ISSUE 9 /
+docs/MULTI_REPLICA.md "Counter handoff"):
+
+- three in-process replicas (full TpuRateLimitCache + RateLimitService
+  stacks on pinned time) behind a real ReplicaRouter/RouterHolder with
+  fault-injected transports (cluster/faults.py);
+- sustained background replay traffic (PR 8's benchmarks/replay.py
+  zipf generator) from a closed worker pool, saturating the cluster;
+- a fixed-limit target key driven at 4x its per-window limit, split
+  into a burst before and a burst after the churn;
+- mid-run: one replica is KILLED (ejection + in-request failover),
+  then membership swaps to add a fresh replica — the target key's
+  owner changes.
+
+Two legs:
+- controlled: RouterHolder swaps with the handoff coordinator wired
+  (forwarding window + export/import via LocalAdminTransports — the
+  same code path the proxy drives over HTTP admins).  The target
+  key's counter MOVES: global admitted count stays within
+  limit + slack (no window restart).
+- uncontrolled: plain swap (pre-handoff behavior).  The moved key's
+  window restarts on the new owner and the key demonstrably
+  over-admits (~2x the limit).
+
+The committed artifact (benchmarks/results/membership_churn.json)
+carries both legs plus the assertion outcomes; `make cluster-smoke`
+is the fast CI cousin (scripts/cluster_smoke.py).
+
+Run:  JAX_PLATFORMS=cpu python benchmarks/membership_churn.py
+"""
+
+import itertools
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from replay import _Runtime, workload_zipf  # noqa: E402
+
+from ratelimit_tpu.backends.engine import CounterEngine  # noqa: E402
+from ratelimit_tpu.backends.tpu_cache import TpuRateLimitCache  # noqa: E402
+from ratelimit_tpu.cluster.faults import FaultInjector  # noqa: E402
+from ratelimit_tpu.cluster.handoff import (  # noqa: E402
+    HandoffCoordinator,
+    LocalAdminTransport,
+)
+from ratelimit_tpu.cluster.hashing import owner_id  # noqa: E402
+from ratelimit_tpu.cluster.proxy import RouterHolder  # noqa: E402
+from ratelimit_tpu.cluster.router import ReplicaRouter  # noqa: E402
+from ratelimit_tpu.server.codec import (  # noqa: E402
+    request_from_pb,
+    response_to_pb,
+)
+from ratelimit_tpu.service import RateLimitService  # noqa: E402
+from ratelimit_tpu.stats.manager import Manager  # noqa: E402
+from ratelimit_tpu.utils.time import PinnedTimeSource  # noqa: E402
+
+from ratelimit_tpu.server import pb  # noqa: F401,E402
+from envoy.service.ratelimit.v3 import rls_pb2  # noqa: E402
+
+NOW = 1_700_000_010  # pinned: the minute window never rolls mid-run
+LIMIT = 120  # target key: requests/minute
+ARTIFACT = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "results",
+    "membership_churn.json",
+)
+
+OLD_IDS = ["repl-a", "repl-b", "repl-c"]
+NEW_IDS = ["repl-a", "repl-b", "repl-d"]
+KILLED = "repl-c"
+JOINED = "repl-d"
+
+
+def churn_yaml(target_value: str) -> str:
+    return (
+        "domain: churn\n"
+        "descriptors:\n"
+        "  - key: k\n"
+        f"    value: {target_value}\n"
+        "    rate_limit:\n"
+        "      unit: minute\n"
+        f"      requests_per_unit: {LIMIT}\n"
+        "  - key: k\n"
+        "    rate_limit:\n"
+        "      unit: hour\n"
+        "      requests_per_unit: 100000000\n"
+    )
+
+
+def find_target_value() -> str:
+    """A descriptor value whose owner is a SURVIVOR under the old
+    membership and the JOINING replica under the new one — the key
+    whose counter must travel (not the killed replica's: a dead
+    process has nothing to export)."""
+    for i in range(10_000):
+        v = f"t{i}"
+        stem = f"churn_k_{v}_"
+        if (
+            owner_id(stem, OLD_IDS) in ("repl-a", "repl-b")
+            and owner_id(stem, NEW_IDS) == JOINED
+        ):
+            return v
+    raise RuntimeError("no target value found (hash universe exhausted?)")
+
+
+def build_replica(clock, yaml: str):
+    cache = TpuRateLimitCache(
+        CounterEngine(num_slots=1 << 12, buckets=(8, 32, 128)),
+        clock,
+    )
+    service = RateLimitService(
+        _Runtime({"config.churn": yaml}), cache, Manager()
+    )
+    return cache, service
+
+
+def pb_request(value: str) -> rls_pb2.RateLimitRequest:
+    req = rls_pb2.RateLimitRequest(domain="churn")
+    d = req.descriptors.add()
+    e = d.entries.add()
+    e.key, e.value = "k", value
+    return req
+
+
+def service_transport(service):
+    def call(req, timeout_s=None):
+        return response_to_pb(service.should_rate_limit(request_from_pb(req)))
+
+    return call
+
+
+def p99_ms(samples) -> float:
+    if not samples:
+        return 0.0
+    return float(np.percentile(np.asarray(samples), 99) * 1000.0)
+
+
+def run_leg(controlled: bool, seed: int = 11) -> dict:
+    clock = PinnedTimeSource(NOW)
+    target = find_target_value()
+    yaml = churn_yaml(target)
+    caches, services = {}, {}
+    for rid in set(OLD_IDS + NEW_IDS):
+        caches[rid], services[rid] = build_replica(clock, yaml)
+    faults = FaultInjector()
+
+    def transports(ids):
+        return [faults.wrap(rid, service_transport(services[rid])) for rid in ids]
+
+    def make_router(ids):
+        return ReplicaRouter(
+            ids,
+            transports(ids),
+            eject_after=3,
+            readmit_after_s=30.0,
+            failure_policy="local-cache",
+            retry_max=1,
+            retry_base_s=0.005,
+        )
+
+    handoff = None
+    if controlled:
+        admins = {
+            rid: LocalAdminTransport(caches[rid])
+            for rid in set(OLD_IDS + NEW_IDS)
+            if rid != KILLED  # a dead process has no admin surface
+        }
+        handoff = HandoffCoordinator(admins.get).run
+    holder = RouterHolder(make_router(OLD_IDS), handoff=handoff)
+
+    # -- background replay load (closed pool over zipf events) --------
+    events = workload_zipf(
+        20_000, rate=1000.0, domains=(("churn", 1.0),), n_keys=64, seed=seed
+    )
+    ev_counter = itertools.count()
+    stop_bg = threading.Event()
+    bg_done = [0] * 16
+    bg_lat: list = []
+    bg_lat_lock = threading.Lock()
+
+    def bg_worker(w):
+        local = []
+        while not stop_bg.is_set():
+            ev = events[next(ev_counter) % len(events)]
+            t0 = time.perf_counter()
+            try:
+                holder.should_rate_limit(pb_request(ev.key), timeout_s=5.0)
+            except Exception:
+                pass
+            local.append(time.perf_counter() - t0)
+            bg_done[w] += 1
+        with bg_lat_lock:
+            bg_lat.extend(local[::7])  # sample to bound memory
+
+    bg_threads = [
+        threading.Thread(target=bg_worker, args=(w,), daemon=True)
+        for w in range(16)
+    ]
+    t_run0 = time.perf_counter()
+    for t in bg_threads:
+        t.start()
+
+    # -- target-key driver --------------------------------------------
+    def burst(n, pace_s=0.008):
+        admitted = 0
+        lat = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            resp = holder.should_rate_limit(pb_request(target), timeout_s=5.0)
+            lat.append(time.perf_counter() - t0)
+            if resp.overall_code == rls_pb2.RateLimitResponse.OK:
+                admitted += 1
+            time.sleep(pace_s)
+        return admitted, lat
+
+    # Phase 1: 2x the window limit offered before any churn.
+    adm1, lat1 = burst(2 * LIMIT)
+
+    # Kill one replica mid-stream: ejection + in-request failover keep
+    # the cluster answering (background load is flowing throughout).
+    faults.kill(KILLED)
+    time.sleep(0.6)
+    stats_degraded = holder.stats()
+
+    # Membership change: the killed replica leaves, a fresh one joins;
+    # the target key's owner moves to the joiner.
+    holder.swap(make_router(NEW_IDS), grace_s=1.0)
+    if controlled:
+        deadline = time.monotonic() + 10.0
+        while holder.last_handoff is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert holder.last_handoff is not None, "handoff never completed"
+
+    # Phase 2: 2x the limit again, now against the new owner.
+    adm2, lat2 = burst(2 * LIMIT)
+
+    stop_bg.set()
+    for t in bg_threads:
+        t.join(timeout=10)
+    elapsed = time.perf_counter() - t_run0
+    holder.close()
+
+    st = holder.stats()
+    out = {
+        "controlled": controlled,
+        "target_value": target,
+        "limit_per_minute": LIMIT,
+        "offered_target": 4 * LIMIT,
+        "admitted_target": adm1 + adm2,
+        "admitted_phase1": adm1,
+        "admitted_phase2": adm2,
+        "target_p99_ms": round(p99_ms(lat1 + lat2), 3),
+        "background_requests": int(sum(bg_done)),
+        "background_rps": round(sum(bg_done) / elapsed, 1),
+        "background_p99_ms": round(p99_ms(bg_lat), 3),
+        "elapsed_s": round(elapsed, 2),
+        "degraded_at_kill": {
+            k: stats_degraded[k]
+            for k in ("ejections", "failovers", "fallback_descriptors",
+                      "retries", "live_replicas")
+        },
+        "router_final": {
+            k: st[k]
+            for k in ("ejections", "failovers", "fallback_descriptors",
+                      "forwarded", "degraded_denials", "retries")
+        },
+        "handoff": holder.last_handoff,
+    }
+    for rid in sorted(caches):
+        if rid == KILLED:
+            continue
+        snap = caches[rid].handoff_log.snapshot()
+        out.setdefault("replicas", {})[rid] = {
+            "exported_keys": snap["exported_keys"],
+            "imported_keys": snap["imported_keys"],
+            "merged_keys": snap["merged_keys"],
+        }
+    return out
+
+
+def main() -> int:
+    print("== membership churn: controlled (handoff) leg ==")
+    controlled = run_leg(True)
+    print(json.dumps(controlled, indent=2))
+    print("== membership churn: uncontrolled (no handoff) leg ==")
+    uncontrolled = run_leg(False)
+    print(json.dumps(uncontrolled, indent=2))
+
+    # The documented bound: with handoff, a moved key's counter
+    # travels — total admissions for the fixed-limit key stay within
+    # limit + slack (slack: requests in flight against the old owner
+    # between its export snapshot and the forwarding window closing).
+    slack = 5
+    checks = {
+        "controlled_within_bound": controlled["admitted_target"]
+        <= LIMIT + slack,
+        "uncontrolled_over_admits": uncontrolled["admitted_target"]
+        >= LIMIT + 50,
+        "handoff_moved_target": (controlled["handoff"] or {}).get(
+            "imported", 0
+        )
+        + (controlled["handoff"] or {}).get("merged", 0)
+        > 0,
+        # Pre-swap router stats: the swap installs a fresh router, so
+        # the kill-phase evidence lives in the degraded_at_kill snap.
+        "replica_ejected": controlled["degraded_at_kill"]["ejections"] >= 1,
+        "failover_served_killed_replicas_keys": controlled[
+            "degraded_at_kill"
+        ]["failovers"]
+        >= 1,
+        "no_keys_lost_in_transfer": (
+            (controlled["handoff"] or {}).get("imported", 0)
+            + (controlled["handoff"] or {}).get("merged", 0)
+            == (controlled["handoff"] or {}).get("moved_keys", -1)
+        ),
+        "target_p99_controlled_ms": controlled["target_p99_ms"] < 250.0,
+    }
+    artifact = {
+        "benchmark": "membership_churn",
+        "scenario": (
+            f"kill {KILLED} + join {JOINED} under sustained zipf replay "
+            f"load; target key offered 4x its {LIMIT}/min limit "
+            "(2x before the churn, 2x after)"
+        ),
+        "bound": f"admitted <= limit + {slack} (controlled leg)",
+        "platform": os.environ.get("JAX_PLATFORMS", "default"),
+        "controlled": controlled,
+        "uncontrolled": uncontrolled,
+        "checks": checks,
+    }
+    os.makedirs(os.path.dirname(ARTIFACT), exist_ok=True)
+    with open(ARTIFACT, "w") as f:
+        json.dump(artifact, f, indent=2, sort_keys=False)
+        f.write("\n")
+    print(f"artifact written to {ARTIFACT}")
+    failed = [k for k, ok in checks.items() if not ok]
+    if failed:
+        print(f"FAILED checks: {failed}")
+        return 1
+    print("all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

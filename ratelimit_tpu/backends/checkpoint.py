@@ -34,6 +34,38 @@ logger = logging.getLogger("ratelimit.checkpoint")
 
 FORMAT_VERSION = 1
 
+# Restore-age guard: the longest fixed-window unit is a DAY, so no
+# live counter can still be enforceable once a snapshot is older than
+# that — restoring one would resurrect expired windows (and a stale
+# handoff import could over-deny forever on stable-stem banks).
+# Snapshots older than this are refused (skip-and-start-fresh).
+MAX_RESTORE_AGE_S = 86400.0
+
+
+def bank_roles(cache) -> list:
+    """Topology names for each cache.engines() position: lanes by
+    index/count, the per-second bank by name, algorithm banks by
+    algorithm, plain banks otherwise.  The restore/handoff guard that
+    keeps a topology change from feeding one bank's keys into a
+    different-purpose engine (restore_engine; cluster/handoff.py uses
+    the same names to route imported sections)."""
+    engines = cache.engines()
+    lanes = getattr(cache, "lanes", None)
+    per_second = getattr(cache, "per_second_engine", None)
+    algo_banks = getattr(cache, "algorithm_banks", None) or {}
+    algo_by_id = {id(e): name for name, e in algo_banks.items()}
+    roles = []
+    for idx, e in enumerate(engines):
+        if lanes is not None and idx < len(lanes) and e is lanes[idx]:
+            roles.append(f"lane{idx}of{len(lanes)}")
+        elif per_second is not None and e is per_second:
+            roles.append("per_second")
+        elif id(e) in algo_by_id:
+            roles.append("algo_" + algo_by_id[id(e)])
+        else:
+            roles.append(f"bank{idx}")
+    return roles
+
 
 def snapshot_engine(engine) -> tuple:
     """Copy one bank's state: (state dict, entries).  The state dict
@@ -111,12 +143,22 @@ def save_engine(engine, path: str, role: str = "") -> None:
     )
 
 
-def restore_engine(engine, path: str, role: str = "") -> bool:
+def restore_engine(
+    engine,
+    path: str,
+    role: str = "",
+    max_age_s: float = MAX_RESTORE_AGE_S,
+    wall_now=time.time,
+) -> bool:
     """Restore one engine bank from `path`; returns False (and leaves
     the engine fresh) if the snapshot is missing or incompatible.
     When both sides carry a bank `role`, a mismatch refuses the
     restore (logged skip-and-start-fresh, like the num_slots guard);
-    snapshots from before roles existed restore as before."""
+    snapshots from before roles existed restore as before.  A snapshot
+    older than ``max_age_s`` (default: one day, the longest window
+    unit) is refused — every counter in it has expired, and restoring
+    would resurrect dead windows (0 disables the guard; ``wall_now``
+    is the clock seam for tests)."""
     if not os.path.exists(path):
         return False
     try:
@@ -124,6 +166,17 @@ def restore_engine(engine, path: str, role: str = "") -> bool:
             meta = json.loads(bytes(z["meta"]).decode())
             if meta.get("version") != FORMAT_VERSION:
                 logger.warning("checkpoint %s: unknown version, skipping", path)
+                return False
+            age_s = wall_now() - meta.get("saved_at", 0)  # tpu-lint: disable=timing-discipline -- cross-restart age: wall stamps are all that survive a process boundary
+            if max_age_s and age_s > max_age_s:
+                logger.warning(
+                    "checkpoint %s: snapshot is %.0fs old (> %.0fs, the "
+                    "longest window unit) — refusing to resurrect "
+                    "expired counters, starting fresh",
+                    path,
+                    age_s,
+                    max_age_s,
+                )
                 return False
             saved_role = meta.get("role", "")
             if role and saved_role and saved_role != role:
@@ -216,27 +269,7 @@ class CheckpointManager:
         return os.path.join(self.directory, f"bank{idx}.npz")
 
     def _bank_roles(self) -> list:
-        """Topology names for each engines() position: lanes by
-        index/count, the per-second bank by name, plain banks
-        otherwise — the restore guard that keeps a topology change
-        from restoring one bank's keys into a different-purpose
-        engine (see restore_engine)."""
-        engines = self.cache.engines()
-        lanes = getattr(self.cache, "lanes", None)
-        per_second = getattr(self.cache, "per_second_engine", None)
-        algo_banks = getattr(self.cache, "algorithm_banks", None) or {}
-        algo_by_id = {id(e): name for name, e in algo_banks.items()}
-        roles = []
-        for idx, e in enumerate(engines):
-            if lanes is not None and idx < len(lanes) and e is lanes[idx]:
-                roles.append(f"lane{idx}of{len(lanes)}")
-            elif per_second is not None and e is per_second:
-                roles.append("per_second")
-            elif id(e) in algo_by_id:
-                roles.append("algo_" + algo_by_id[id(e)])
-            else:
-                roles.append(f"bank{idx}")
-        return roles
+        return bank_roles(self.cache)
 
     def restore(self) -> int:
         """Restore all banks; returns how many were restored."""

@@ -796,6 +796,86 @@ class CounterEngine:
             put = jax.device_put(put, self._device)
         self._counts = put
 
+    # -- live key-range handoff (cluster/handoff.py) --------------------
+
+    def export_keys(self, pred, drop: bool = True):
+        """Export the live keys matching ``pred(key) -> bool`` for a
+        counter handoff: returns ``(state, entries)`` where ``state``
+        is one column-subset array per export_state row (column i is
+        key i's per-slot state) and ``entries`` is ``[(key, expiry),
+        ...]``.  With ``drop`` (the default) the exported keys leave
+        THIS engine — their slots are zeroed and released — so a key
+        that re-homes back later can never resurrect stale state (the
+        stable-stem algorithm banks keep slots alive indefinitely
+        while hot, so leaving them would not be inert there).
+
+        Must run with exclusive engine access (cache.run_exclusive),
+        like every slot-table touch."""
+        ents = self.slot_table.entries()
+        sel = [(k, s, e) for k, s, e in ents if pred(k)]
+        # Writable copies: device readbacks can come back read-only.
+        state = {
+            name: np.array(arr, copy=True)
+            for name, arr in self.export_state().items()
+        }
+        if not sel:
+            return {name: arr[:0].copy() for name, arr in state.items()}, []
+        idx = np.array([s for _, s, _ in sel], dtype=np.int64)
+        out = {name: arr[idx].copy() for name, arr in state.items()}
+        if drop:
+            for arr in state.values():
+                arr[idx] = 0
+            self.import_state(state)
+            keep = [(k, s, e) for k, s, e in ents if not pred(k)]
+            table_cls = type(self.slot_table)
+            if getattr(self.slot_table, "refresh_expiry", False):
+                self.slot_table = table_cls.from_entries(
+                    self.model.num_slots, keep, refresh_expiry=True
+                )
+            else:
+                self.slot_table = table_cls.from_entries(
+                    self.model.num_slots, keep
+                )
+        return out, [(k, e) for k, _s, e in sel]
+
+    def import_keys(self, state: dict, entries, now: int) -> dict:
+        """Inverse of export_keys, into THIS engine's table: assign a
+        local slot per key and land its state columns.  A key already
+        live locally (requests raced the handoff window) MERGES
+        instead of overwriting: fixed-window ``counts`` add
+        (saturating — both sides counted disjoint hits), every other
+        row takes the element-wise max (GCRA's later TAT and
+        sliding-window's newer window are the stricter/fresher side —
+        the conservative direction; a merge may briefly over-deny,
+        never over-admit).  Entries whose lease already expired at
+        ``now`` are dropped — a stale import cannot resurrect expired
+        counters.  Returns {imported, merged, dropped}.
+
+        Must run with exclusive engine access (cache.run_exclusive)."""
+        res = {"imported": 0, "merged": 0, "dropped": 0}
+        if not entries:
+            return res
+        full = {
+            name: np.array(arr, copy=True)
+            for name, arr in self.export_state().items()
+        }
+        for i, (key, expiry) in enumerate(entries):
+            if int(expiry) <= now:
+                res["dropped"] += 1
+                continue
+            slot, fresh = self.slot_table.assign(key, now, int(expiry))
+            for name, arr in full.items():
+                col = state[name][i]
+                if fresh:
+                    arr[slot] = col
+                elif name == "counts":
+                    arr[slot] = min(int(arr[slot]) + int(col), 0xFFFFFFFF)
+                else:
+                    arr[slot] = max(arr[slot], col)
+            res["imported" if fresh else "merged"] += 1
+        self.import_state(full)
+        return res
+
     def export_counts(self) -> np.ndarray:
         """Flat uint32 copy of the counter table."""
         return np.asarray(jax.device_get(self._counts)).reshape(-1)

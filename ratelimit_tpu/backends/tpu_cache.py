@@ -139,6 +139,13 @@ class TpuRateLimitCache:
         self.time_source = time_source or RealTimeSource()
         self.local_cache = local_cache
         self.key_generator = CacheKeyGenerator(cache_key_prefix)
+        # Cluster counter-handoff bookkeeping (cluster/handoff.py
+        # export_from_cache/import_into_cache write it; /debug/cluster
+        # and the ratelimit.cluster.* counter family read it).  The
+        # import is jax- and grpc-free (hashing + numpy only).
+        from ..cluster.handoff import HandoffLog
+
+        self.handoff_log = HandoffLog()
         # Descriptor-resolution fast path (limiter/resolution.py): the
         # service resolves each descriptor through this once per config
         # generation; do_limit then reuses the memoized key, lane route
@@ -982,6 +989,10 @@ class TpuRateLimitCache:
             )
         if self.hotkeys is not None:
             self.hotkeys.register_stats(store, scope + ".hotkeys")
+        # Cluster handoff family (fixed literal scope: these are
+        # cluster-tier counters, not backend-tier — the name the
+        # INCIDENT_RUNBOOK and dashboards key on).
+        self.handoff_log.register_stats(store, "ratelimit.cluster")
         # Shadow-rollout divergence family (docs/ALGORITHMS.md): one
         # agree/diverge counter pair per configured algorithm bank —
         # bounded by the algorithm table, not by traffic.

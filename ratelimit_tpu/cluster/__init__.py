@@ -1,7 +1,23 @@
 """Multi-replica scale-out: key-ownership routing across service
-replicas (the DCN tier above the in-host ICI sharding).
+replicas (the DCN tier above the in-host ICI sharding), counter
+handoff on membership change, and the fault-injection harness that
+proves both.
 
 See docs/MULTI_REPLICA.md for the design and its consistency envelope
-vs the reference's shared-Redis model."""
+vs the reference's shared-Redis model.
 
-from .router import ReplicaRouter, owner_of, routing_key  # noqa: F401
+PEP-562 lazy on the router: the hashing/handoff halves are stdlib +
+numpy and are imported by the replica backend (which must never pay a
+grpc import for them); ``ReplicaRouter`` pulls the wire protos only
+when actually used (proxy process, cluster tests).
+"""
+
+from .hashing import owner_of, routing_key  # noqa: F401
+
+
+def __getattr__(name):
+    if name == "ReplicaRouter":
+        from .router import ReplicaRouter
+
+        return ReplicaRouter
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

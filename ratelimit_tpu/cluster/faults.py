@@ -1,0 +1,134 @@
+"""Fault injection for the cluster tier.
+
+The membership-churn claims (docs/MULTI_REPLICA.md) are proven under
+injected faults, not asserted: this module wraps replica transports so
+a test, the churn benchmark (benchmarks/membership_churn.py) or the
+cluster smoke (scripts/cluster_smoke.py) can kill/hang/delay/partition
+a replica MID-STREAM and watch the router eject, degrade, fail over
+and hand counters off.
+
+Transport-level on purpose: from the proxy's point of view a replica
+that SIGKILLed, a blackholed NIC and a partitioned rack are all "the
+sub-call raised UNAVAILABLE / hung past the deadline" — injecting at
+the transport seam exercises the exact classification path
+(`router._is_replica_failure`) production errors take, and works for
+in-process replicas that have no process to kill.  The e2e scenario
+05 already covers the real-SIGKILL flavor; this harness adds the
+modes a process kill cannot express (hangs, delays, asymmetric
+partitions) deterministically.
+
+Stdlib-only; the injected errors are duck-typed gRPC status carriers
+(``.code().name``), the same shape the router's unit tests use.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+
+class FaultStatusError(Exception):
+    """Duck-typed gRPC-status-shaped error (``.code().name`` /
+    ``.details()``), so the router classifies injected faults exactly
+    like real transport errors."""
+
+    def __init__(self, status_name: str, details: str = "injected fault"):
+        super().__init__(f"{status_name}: {details}")
+        self._status_name = status_name
+        self._details = details
+
+    def code(self):
+        class _Code:
+            name = self._status_name
+
+        return _Code()
+
+    def details(self) -> str:
+        return self._details
+
+
+class FaultInjector:
+    """Per-replica fault switchboard shared by every wrapped transport.
+
+    Modes (per replica id; ``heal`` clears):
+      kill       -> every call raises UNAVAILABLE immediately (a dead
+                    or refused process);
+      hang       -> every call blocks for min(hang_s, caller timeout)
+                    then raises DEADLINE_EXCEEDED (a blackholed host);
+      delay      -> every call sleeps ``delay_s`` then passes through
+                    (a slow-but-healthy replica — must NOT eject);
+      partition  -> like kill, but expressed as a SET of unreachable
+                    ids so a test reads as the topology event it is.
+    """
+
+    def __init__(self, sleep: Callable[[float], None] = time.sleep):
+        self._lock = threading.Lock()
+        self._mode: Dict[str, tuple] = {}  # id -> (mode, param)
+        self._sleep = sleep
+        self.stat_injected = 0
+
+    # -- control surface ------------------------------------------------
+
+    def kill(self, replica_id: str) -> None:
+        with self._lock:
+            self._mode[replica_id] = ("kill", 0.0)
+
+    def hang(self, replica_id: str, hang_s: float = 3600.0) -> None:
+        with self._lock:
+            self._mode[replica_id] = ("hang", float(hang_s))
+
+    def delay(self, replica_id: str, delay_s: float) -> None:
+        with self._lock:
+            self._mode[replica_id] = ("delay", float(delay_s))
+
+    def partition(self, *replica_ids: str) -> None:
+        with self._lock:
+            for rid in replica_ids:
+                self._mode[rid] = ("kill", 0.0)
+
+    def heal(self, *replica_ids: str) -> None:
+        """Clear faults on the given ids (all of them when empty)."""
+        with self._lock:
+            if not replica_ids:
+                self._mode.clear()
+            else:
+                for rid in replica_ids:
+                    self._mode.pop(rid, None)
+
+    def mode_of(self, replica_id: str) -> Optional[str]:
+        with self._lock:
+            m = self._mode.get(replica_id)
+            return m[0] if m else None
+
+    # -- transport seam -------------------------------------------------
+
+    def wrap(self, replica_id: str, transport):
+        """Wrap one replica's transport; the returned callable keeps
+        the Transport protocol (request, timeout_s=None)."""
+
+        def call(request, timeout_s=None):
+            with self._lock:
+                m = self._mode.get(replica_id)
+                if m is not None:
+                    self.stat_injected += 1
+            if m is None:
+                return transport(request, timeout_s=timeout_s)
+            mode, param = m
+            if mode == "kill":
+                raise FaultStatusError(
+                    "UNAVAILABLE", f"replica {replica_id} killed"
+                )
+            if mode == "hang":
+                # Block for as long as the caller's timeout allows (a
+                # real blackhole pins the call until the deadline).
+                wait = param if timeout_s is None else min(param, timeout_s)
+                self._sleep(wait)
+                raise FaultStatusError(
+                    "DEADLINE_EXCEEDED", f"replica {replica_id} hung {wait}s"
+                )
+            # delay: slow but healthy.
+            self._sleep(param)
+            return transport(request, timeout_s=timeout_s)
+
+        return call

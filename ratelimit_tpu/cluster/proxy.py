@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import signal
 import threading
 import time
@@ -103,10 +104,15 @@ def build_router(
     max_subcall_s: float = 30.0,
     channel_credentials=None,
     auth_token: str = "",
+    retry_max: int = 0,
+    retry_base_s: float = 0.05,
 ) -> ReplicaRouter:
     """`channel_credentials` (replica_channel_credentials) switches
     the replica channels to TLS/mTLS; `auth_token` adds bearer
-    metadata to every sub-call.  Defaults stay plaintext."""
+    metadata to every sub-call.  Defaults stay plaintext.
+    `retry_max`/`retry_base_s`: same-owner retry budget for transient
+    failures (exponential backoff + jitter, deadline-bounded — see
+    ReplicaRouter)."""
     if channel_credentials is not None:
         channels = [
             grpc.secure_channel(a, channel_credentials)
@@ -123,6 +129,8 @@ def build_router(
         readmit_after_s=readmit_after_s,
         failure_policy=failure_policy,
         transport_ceiling_s=max_subcall_s,
+        retry_max=retry_max,
+        retry_base_s=retry_base_s,
     )
 
 
@@ -134,15 +142,25 @@ class RouterHolder:
     with one reference assignment (readers see either the old or the
     new router, never a mix — the same single-slot-swap discipline as
     the config hot-reload).  Rendezvous hashing makes the data-plane
-    consequence minimal: only keys whose owner changed (~1/n) move,
-    and those counters restart their window (the documented amnesia
-    envelope, docs/MULTI_REPLICA.md).  The old router's thread pool is
-    retired after a grace period; its gRPC channels stay open for the
-    process lifetime (bounded by membership churn).
+    consequence minimal: only keys whose owner changed (~1/n) move.
+
+    Without a handoff coordinator those moved counters restart their
+    window (the historical amnesia envelope).  With one (``handoff``:
+    a ``(old_ids, new_ids) -> summary`` callable, normally
+    cluster.handoff.HandoffCoordinator.run), the swap arms the new
+    router's FORWARDING window (moved keys keep hitting their old
+    owner — admission stays exact), runs the export/import in a
+    background thread, and closes the window when the transfer lands;
+    see docs/MULTI_REPLICA.md for the resulting envelope.  The old
+    router's thread pool is retired after a grace period; its gRPC
+    channels stay open for the process lifetime (bounded by
+    membership churn).
     """
 
-    def __init__(self, router: ReplicaRouter):
+    def __init__(self, router: ReplicaRouter, handoff=None):
         self._router = router
+        self._handoff = handoff
+        self.last_handoff: Optional[dict] = None
 
     @property
     def replica_ids(self) -> List[str]:
@@ -154,28 +172,72 @@ class RouterHolder:
         return self._router.live_replica_count() > 0
 
     def stats(self) -> dict:
-        return self._router.stats()
+        out = self._router.stats()
+        if self.last_handoff is not None:
+            out["last_handoff"] = self.last_handoff
+        return out
 
     def should_rate_limit(self, request, timeout_s=None):
         return self._router.should_rate_limit(request, timeout_s=timeout_s)
 
     def swap(self, new_router: ReplicaRouter, grace_s: float = 30.0) -> None:
+        old_ids = list(self._router.replica_ids)
+        if self._handoff is not None:
+            # Arm the forwarding window BEFORE the new router serves:
+            # a moved key's first post-swap request must still land on
+            # its old owner or its counter forks.
+            new_router.begin_forwarding(old_ids)
         old, self._router = self._router, new_router
-        t = threading.Timer(grace_s, old.close)
-        t.daemon = True
-        t.start()
+        if self._handoff is not None:
+            t = threading.Thread(
+                target=self._run_handoff,
+                args=(old_ids, new_router),
+                name="cluster-handoff",
+                daemon=True,
+            )
+            t.start()
+        t2 = threading.Timer(grace_s, old.close)
+        t2.daemon = True
+        t2.start()
+
+    def _run_handoff(self, old_ids: List[str], new_router: ReplicaRouter):
+        try:
+            self.last_handoff = self._handoff(
+                old_ids, list(new_router.replica_ids)
+            )
+        except Exception:
+            logger.exception(
+                "membership handoff failed; moved keys restart their "
+                "windows (pre-handoff amnesia envelope)"
+            )
+        finally:
+            # Whatever happened, stop forwarding: the new owners are
+            # authoritative from here (with or without history).
+            new_router.end_forwarding()
 
     def close(self) -> None:
         self._router.close()
 
 
 def read_replicas_file(path: str) -> List[str]:
-    """One address per line (or comma/space separated); '#' comments."""
+    """One address per line (or comma/space separated); '#' comments.
+
+    Entries are VALIDATED as ``host:port``: one unparseable token
+    raises, which the watcher's keep-old-on-error rule turns into
+    "keep the current membership and retry next poll" — the same
+    whole-file-or-nothing discipline as config reload (a half-garbled
+    membership write must never eject half the cluster)."""
     addrs: List[str] = []
     with open(path) as f:
         for line in f:
             line = line.split("#", 1)[0]
             for tok in line.replace(",", " ").split():
+                host, sep, port = tok.rpartition(":")
+                if not sep or not host or not port.isdigit():
+                    raise ValueError(
+                        f"replicas file {path}: unparseable entry {tok!r} "
+                        "(want host:port); keeping current membership"
+                    )
                 addrs.append(tok)
     return addrs
 
@@ -365,6 +427,11 @@ def start_debug_server(holder, host: str, port: int):
             h._reply(500, b"NOT_SERVING")
 
     srv.add_route("GET", "/stats.json", stats_json)
+    # Same body under the name the runbook teaches (the replicas'
+    # /debug/cluster shows the handoff half; this one shows the
+    # routing half: per-replica circuit state, degraded counters,
+    # last handoff summary).
+    srv.add_route("GET", "/debug/cluster", stats_json)
     srv.add_route("GET", "/healthcheck", healthcheck)
     srv.start()
     logger.warning("proxy debug listener on :%d", srv.bound_port)
@@ -544,9 +611,40 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "probe re-tests it",
     )
     p.add_argument(
-        "--failure-mode", choices=("open", "closed"), default="open",
+        "--failure-mode",
+        choices=("allow", "deny", "local-cache", "open", "closed"),
+        default=os.environ.get("CLUSTER_FAILURE_MODE", "allow"),  # tpu-lint: disable=env-discipline -- proxy process: flag default only, documented as Settings.cluster_failure_mode; no reload seam exists here
         help="answer for descriptors no live replica can serve: "
-        "'open' admits (envoy failure-mode-allow), 'closed' denies",
+        "'allow' admits (envoy failure-mode-allow), 'deny' answers "
+        "OVER_LIMIT, 'local-cache' denies only keys recently seen "
+        "over limit on a healthy pass (the reference's freecache "
+        "over-limit cache) and admits the rest; 'open'/'closed' are "
+        "the historical aliases of allow/deny.  Default comes from "
+        "the CLUSTER_FAILURE_MODE env var (settings.py)",
+    )
+    p.add_argument(
+        "--retry-max", type=int,
+        default=int(os.environ.get("CLUSTER_RETRY_MAX", "1")),  # tpu-lint: disable=env-discipline -- proxy process: flag default only; no reload seam exists here
+        help="same-owner retries for a TRANSIENT sub-call failure "
+        "before the failover pass re-owns the descriptors "
+        "(exponential backoff + jitter from --retry-base-seconds, "
+        "never past the caller's remaining deadline); 0 disables",
+    )
+    p.add_argument(
+        "--retry-base-seconds", type=float, default=0.05,
+        help="base backoff for --retry-max (doubles per attempt, "
+        "x[0.5,1.5) jitter, capped at 2s)",
+    )
+    p.add_argument(
+        "--replica-admin", default="",
+        help="enable COUNTER HANDOFF on membership change: comma "
+        "list mapping each replica's gRPC identity to its debug "
+        "listener, e.g. '10.0.0.1:8081=http://10.0.0.1:6070,...' "
+        "(replicas need CLUSTER_HANDOFF_ENABLED=1).  On a swap the "
+        "proxy forwards moved keys to their old owner while the "
+        "exported counters land on the new owner, so no counter "
+        "resets (docs/MULTI_REPLICA.md).  Empty keeps the historical "
+        "window-restart behavior",
     )
     p.add_argument(
         "--max-subcall-seconds", type=float, default=30.0,
@@ -612,6 +710,25 @@ def main(argv=None) -> None:
             max_subcall_s=args.max_subcall_seconds,
             channel_credentials=replica_creds,
             auth_token=args.auth_token,
+            retry_max=args.retry_max,
+            retry_base_s=args.retry_base_seconds,
+        )
+
+    handoff = None
+    if args.replica_admin:
+        from .handoff import (
+            HandoffCoordinator,
+            HttpAdminTransport,
+            parse_admin_map,
+        )
+
+        admin_urls = parse_admin_map(args.replica_admin)
+        admins = {
+            rid: HttpAdminTransport(url) for rid, url in admin_urls.items()
+        }
+        handoff = HandoffCoordinator(admins.get).run
+        logger.warning(
+            "counter handoff enabled over %d admin endpoints", len(admins)
         )
 
     if args.replicas_file:
@@ -622,7 +739,7 @@ def main(argv=None) -> None:
         )
     else:
         addrs = [a.strip() for a in args.replicas.split(",") if a.strip()]
-    holder = RouterHolder(build(addrs))
+    holder = RouterHolder(build(addrs), handoff=handoff)
     if args.replicas_file:
         watch_replicas_file(
             holder, args.replicas_file, args.poll_seconds, build=build

@@ -38,8 +38,8 @@ can never stretch the total past the caller's deadline.
 
 from __future__ import annotations
 
-import hashlib
 import logging
+import random
 import threading
 from concurrent.futures import ThreadPoolExecutor
 import time
@@ -49,40 +49,13 @@ from ..server import pb  # noqa: F401  (sys.path for generated protos)
 
 from envoy.service.ratelimit.v3 import rls_pb2  # noqa: E402
 
+# The hash identity lives in cluster/hashing.py (stdlib-only) so the
+# replica backend can evaluate the same ownership predicate over its
+# stored keys during counter handoff; re-exported here for the
+# existing import surface.
+from .hashing import owner_of, routing_key  # noqa: E402,F401
+
 logger = logging.getLogger("ratelimit.cluster.router")
-
-
-def routing_key(domain: str, descriptor) -> str:
-    """Window-less counter identity of one descriptor: the reference's
-    cache key (cache_key.go:62-74) minus the window-start suffix, so
-    every window of a counter routes to the same owner."""
-    parts = [domain]
-    for entry in descriptor.entries:
-        parts.append(f"{entry.key}_{entry.value}")
-    return "|".join(parts)
-
-
-def _score(replica_id: str, key: str) -> int:
-    h = hashlib.blake2b(
-        f"{replica_id}|{key}".encode("utf-8"), digest_size=8
-    )
-    return int.from_bytes(h.digest(), "big")
-
-
-def owner_of(key: str, replica_ids: Sequence[str]) -> int:
-    """Rendezvous owner: index (into THIS list) of the replica with
-    the highest score; the id strings, not the positions, are the
-    stable identity.  Score ties break toward the lexically-LARGEST
-    id — any reimplementation (a proxy in another language) must use
-    the same rule or tied keys would split across two owners."""
-    best_i = 0
-    best = None
-    for i, rid in enumerate(replica_ids):
-        s = (_score(rid, key), rid)
-        if best is None or s > best:
-            best = s
-            best_i = i
-    return best_i
 
 
 class DeadlineExceededError(RuntimeError):
@@ -196,6 +169,60 @@ class _Circuit:
         self.probe_until = 0.0
 
 
+# Proto RateLimit.Unit -> seconds (the wire enum, not api.Unit): the
+# TTL an OVER_LIMIT verdict stays trustworthy in the degraded-mode
+# cache — at most the remainder of the window that produced it, upper-
+# bounded by one full window.  Unknown units fall back to a minute.
+_UNIT_TTL_S = {1: 1.0, 2: 60.0, 3: 3600.0, 4: 86400.0}
+
+
+class OverLimitCache:
+    """Degraded-mode local over-limit cache (the reference's freecache
+    OVER_LIMIT cache, LocalCacheSize + failure semantics, applied at
+    the proxy): remembers which routing stems were recently OVER_LIMIT
+    on a HEALTHY pass, so when the owner is down the
+    ``local-cache`` failure mode can keep denying known-hot keys while
+    admitting everything else — strictly between fail-allow (admits
+    hot keys too) and fail-deny (denies cold keys too).
+
+    Bounded: past ``capacity`` the soonest-to-expire entry is evicted
+    (the same closest-to-expiry policy as overload's PromotionCache).
+    All access under one small lock; this path only runs on sub-call
+    failure, never on the healthy hot path."""
+
+    def __init__(self, capacity: int = 4096, clock=time.monotonic):
+        self.capacity = int(capacity)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._map: Dict[str, float] = {}  # routing stem -> expiry
+        self.stat_hits = 0
+        self.stat_inserts = 0
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def put(self, stem: str, ttl_s: float) -> None:
+        now = self._clock()
+        with self._lock:
+            if stem not in self._map and len(self._map) >= self.capacity:
+                victim = min(self._map, key=self._map.get)
+                del self._map[victim]
+            self._map[stem] = now + ttl_s
+            self.stat_inserts += 1
+
+    def hit(self, stem: str) -> bool:
+        now = self._clock()
+        with self._lock:
+            exp = self._map.get(stem)
+            if exp is None:
+                return False
+            if exp <= now:
+                del self._map[stem]
+                return False
+            self.stat_hits += 1
+            return True
+
+
 class Transport(Protocol):
     """One replica endpoint.  `timeout_s` is the time REMAINING in
     the caller's budget when this call starts (None = no deadline);
@@ -216,6 +243,16 @@ class ReplicaRouter:
     (use host:port, not list position).
     """
 
+    # CLUSTER_FAILURE_MODE vocabulary (the reference's
+    # FAILURE_MODE_DENY + local over-limit cache semantics):
+    # "allow" admits descriptors no live replica could serve, "deny"
+    # answers OVER_LIMIT, "local-cache" denies only stems recently
+    # seen OVER_LIMIT on a healthy pass (OverLimitCache) and admits
+    # the rest.  "open"/"closed" stay accepted as the historical
+    # aliases of allow/deny.
+    _FAILURE_ALIASES = {"open": "allow", "closed": "deny"}
+    FAILURE_MODES = ("allow", "deny", "local-cache")
+
     def __init__(
         self,
         replica_ids: Sequence[str],
@@ -225,33 +262,79 @@ class ReplicaRouter:
         readmit_after_s: float = 5.0,
         failure_policy: str = "open",
         transport_ceiling_s: float = 30.0,
+        retry_max: int = 0,
+        retry_base_s: float = 0.05,
+        retry_cap_s: float = 2.0,
+        rng: Optional[random.Random] = None,
+        sleep=time.sleep,
+        flight=None,
     ):
         """`eject_after`: consecutive replica-health failures before a
         replica's circuit opens and its keys re-own to the survivors
         (0 disables ejection).  `readmit_after_s`: how long an open
         circuit waits before the replica re-enters the candidate set
         as a half-open probe.  `failure_policy`: what a descriptor
-        gets when NO replica could answer for it — "open" admits
-        (plain OK, envoy's failure_mode allow default), "closed"
-        denies (OVER_LIMIT).  `transport_ceiling_s`: the transports'
-        own timeout ceiling (proxy --max-subcall-seconds) — used to
-        classify DEADLINE_EXCEEDED as hang vs tight-caller-budget."""
+        gets when NO replica could answer for it — see FAILURE_MODES.
+        `transport_ceiling_s`: the transports' own timeout ceiling
+        (proxy --max-subcall-seconds) — used to classify
+        DEADLINE_EXCEEDED as hang vs tight-caller-budget.
+        `retry_max`: transient sub-call failures are retried against
+        the SAME owner up to this many times with exponential backoff
+        + jitter (`retry_base_s` doubling per attempt, capped at
+        `retry_cap_s`, x[0.5,1.5) jitter) BEFORE the failover pass
+        re-owns the descriptors; a retry never sleeps past the
+        caller's remaining absolute deadline.  0 keeps the historical
+        fail-straight-to-failover behavior.  `rng`/`sleep` are test
+        seams.  `flight` (an observability FlightRecorder) stamps
+        degraded-mode and forwarded decisions when provided."""
         if len(replica_ids) != len(transports):
             raise ValueError("replica_ids and transports length mismatch")
         if not replica_ids:
             raise ValueError("need at least one replica")
         if len(set(replica_ids)) != len(replica_ids):
             raise ValueError("replica ids must be unique")
-        if failure_policy not in ("open", "closed"):
+        failure_policy = self._FAILURE_ALIASES.get(
+            failure_policy, failure_policy
+        )
+        if failure_policy not in self.FAILURE_MODES:
             raise ValueError(
-                f"failure_policy must be 'open' or 'closed': {failure_policy!r}"
+                "failure_policy must be one of "
+                f"{self.FAILURE_MODES} (or the open/closed aliases): "
+                f"{failure_policy!r}"
             )
         self.replica_ids = list(replica_ids)
         self.transports = list(transports)
+        self._id_index = {rid: i for i, rid in enumerate(self.replica_ids)}
         self.eject_after = int(eject_after)
         self.readmit_after_s = float(readmit_after_s)
         self.failure_policy = failure_policy
         self.transport_ceiling_s = float(transport_ceiling_s)
+        self.retry_max = int(retry_max)
+        self.retry_base_s = float(retry_base_s)
+        self.retry_cap_s = float(retry_cap_s)
+        self._rng = rng or random.Random()
+        self._sleep = sleep
+        self.flight = flight
+        self._fc_degraded = self._fc_forwarded = 0
+        if flight is not None:
+            from ..observability.flight import (
+                FLIGHT_CODE_DEGRADED,
+                FLIGHT_CODE_FORWARDED,
+            )
+
+            self._fc_degraded = FLIGHT_CODE_DEGRADED
+            self._fc_forwarded = FLIGHT_CODE_FORWARDED
+        self.over_limit_cache = (
+            OverLimitCache() if failure_policy == "local-cache" else None
+        )
+        # Counter-handoff forwarding window (docs/MULTI_REPLICA.md):
+        # while set, this is the PREVIOUS membership's id list — keys
+        # whose owner changed keep routing to their OLD owner (when it
+        # survives in the new set and its circuit is closed) so
+        # admission stays exact until the handoff import lands.
+        # Single-slot swap discipline: request threads read the
+        # attribute once; begin/end assign whole lists/None.
+        self._forward_old_ids: Optional[List[str]] = None
         # Hang classification floor: a DEADLINE_EXCEEDED ejects only
         # when the expired timeout was at least this long.  Derived
         # from the ceiling so a deliberately-low --max-subcall-seconds
@@ -277,12 +360,31 @@ class ReplicaRouter:
         self.stat_readmissions = 0
         self.stat_failovers = 0  # sub-requests re-routed to a survivor
         self.stat_fallback_descriptors = 0  # answered by failure policy
+        self.stat_retries = 0  # same-owner retries after backoff
+        self.stat_forwarded = 0  # descriptors forwarded to old owners
+        self.stat_degraded_denials = 0  # local-cache denials while degraded
         self._pool = ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="replica-router"
         )
 
     def stats(self) -> dict:
-        """Snapshot of the failover counters + live membership."""
+        """Snapshot of the failover counters + live membership +
+        per-replica circuit detail (the /debug/cluster and /stats.json
+        surface)."""
+        with self._health_lock:
+            now = time.monotonic()
+            states = [
+                {
+                    "id": rid,
+                    "state": (
+                        "open"
+                        if c.is_open and now < c.retry_at
+                        else ("half-open" if c.is_open else "closed")
+                    ),
+                    "consecutive_failures": c.failures,
+                }
+                for rid, c in zip(self.replica_ids, self._circuits)
+            ]
         return {
             "replicas": len(self.replica_ids),
             "live_replicas": self.live_replica_count(),
@@ -290,7 +392,25 @@ class ReplicaRouter:
             "readmissions": self.stat_readmissions,
             "failovers": self.stat_failovers,
             "fallback_descriptors": self.stat_fallback_descriptors,
+            "retries": self.stat_retries,
+            "forwarded": self.stat_forwarded,
+            "degraded_denials": self.stat_degraded_denials,
+            "failure_mode": self.failure_policy,
+            "forwarding_active": self._forward_old_ids is not None,
+            "replica_states": states,
         }
+
+    # -- counter-handoff forwarding window ------------------------------
+
+    def begin_forwarding(self, old_ids: Sequence[str]) -> None:
+        """Route keys whose owner changed vs `old_ids` to their OLD
+        owner until end_forwarding() — the dual-write/forwarding
+        window of a membership change (cluster/handoff.py runs the
+        export/import while this is active, so no counter resets)."""
+        self._forward_old_ids = list(old_ids)  # tpu-lint: disable=shared-state -- single-slot swap: writers assign a whole fresh list (GIL-atomic); readers take one snapshot per request
+
+    def end_forwarding(self) -> None:
+        self._forward_old_ids = None  # tpu-lint: disable=shared-state -- single-slot swap (see begin_forwarding)
 
     def close(self) -> None:
         self._pool.shutdown(wait=False)
@@ -439,6 +559,43 @@ class ReplicaRouter:
         self._record_success(idx)
         return resp
 
+    def _call_retrying(self, idx: int, sub_request, remaining):
+        """_checked_call plus bounded same-owner retries on transient
+        replica failures: exponential backoff with jitter, stopping
+        early when the replica's circuit opened meanwhile (failover
+        handles it) or when the caller's remaining absolute deadline
+        cannot cover the backoff — a retry must NEVER stretch the
+        total past the caller's budget (the deadline contract of
+        should_rate_limit)."""
+        attempt = 0
+        while True:
+            try:
+                return self._checked_call(idx, sub_request, remaining)
+            except _ReplicaCallError:
+                if attempt >= self.retry_max:
+                    raise
+                with self._health_lock:
+                    circuit_open = self._circuits[idx].is_open
+                if circuit_open:
+                    # Ejected mid-retry: hammering it again only burns
+                    # the caller's budget; let failover re-own.
+                    raise
+                backoff = min(
+                    self.retry_cap_s, self.retry_base_s * (2.0 ** attempt)
+                ) * (0.5 + self._rng.random())
+                try:
+                    left = remaining()
+                except DeadlineExceededError:
+                    raise  # budget already gone: surface the expiry
+                if left is not None and left <= backoff + self.retry_base_s:
+                    # Not enough budget for the sleep plus a useful
+                    # attempt: give the remaining time to failover.
+                    raise
+                self._sleep(backoff)
+                with self._health_lock:
+                    self.stat_retries += 1
+                attempt += 1
+
     def _sub_request(self, request, rows: List[int]):
         sub = rls_pb2.RateLimitRequest(
             domain=request.domain, hits_addend=request.hits_addend
@@ -459,15 +616,32 @@ class ReplicaRouter:
         claim-release bookkeeping cannot diverge between them."""
         n = len(request.descriptors)
         cand_ids = [self.replica_ids[i] for i in cand]
+        cand_set = set(cand)
+        forward_ids = self._forward_old_ids  # one read: swap-safe
         by_owner: Dict[int, List[int]] = {}
+        forwarded = 0
         for i in rows:
-            owner = cand[
-                owner_of(
-                    routing_key(request.domain, request.descriptors[i]),
-                    cand_ids,
-                )
-            ]
+            key = routing_key(request.domain, request.descriptors[i])
+            owner = cand[owner_of(key, cand_ids)]
+            if forward_ids is not None:
+                # Handoff forwarding window: a key whose owner changed
+                # keeps hitting its OLD owner (if it survives in the
+                # new set with a closed circuit) so its counter keeps
+                # counting in one place until the import lands.
+                old_id = forward_ids[owner_of(key, forward_ids)]
+                if old_id != self.replica_ids[owner]:
+                    j = self._id_index.get(old_id)
+                    if j is not None and j in cand_set:
+                        owner = j
+                        forwarded += 1
             by_owner.setdefault(owner, []).append(i)
+        if forwarded:
+            with self._health_lock:
+                self.stat_forwarded += forwarded
+            if self.flight is not None:
+                self.flight.record(
+                    request.domain, self._fc_forwarded, forwarded, 0.0
+                )
         # A claimed probe this request routes nothing to would starve
         # recovery if we kept holding it.
         self._release_probes([i for i in claimed if i not in by_owner])
@@ -481,7 +655,7 @@ class ReplicaRouter:
             try:
                 return (
                     sub_rows,
-                    self._checked_call(owner, sub, remaining),
+                    self._call_retrying(owner, sub, remaining),
                     None,
                 )
             except _ReplicaCallError as e:
@@ -503,17 +677,57 @@ class ReplicaRouter:
         results.extend(f.result() for f in futures)
         return results
 
-    def _fallback_response(self, n: int) -> rls_pb2.RateLimitResponse:
-        """Every-replica-unreachable answer per the failure policy."""
-        with self._health_lock:
-            self.stat_fallback_descriptors += n
+    def _fallback_code(self, request, i: int) -> int:
+        """Degraded-mode answer for ONE descriptor whose owner is
+        unreachable, per CLUSTER_FAILURE_MODE: allow -> OK, deny ->
+        OVER_LIMIT, local-cache -> OVER_LIMIT only when the stem was
+        recently over limit on a healthy pass (the reference's
+        freecache over-limit cache under FAILURE_MODE_DENY=false)."""
         OVER = rls_pb2.RateLimitResponse.OVER_LIMIT
         OK = rls_pb2.RateLimitResponse.OK
-        code = OK if self.failure_policy == "open" else OVER
-        out = rls_pb2.RateLimitResponse(overall_code=code if n else OK)
-        for _ in range(n):
+        if self.failure_policy == "deny":
+            return OVER
+        if self.failure_policy == "local-cache":
+            stem = routing_key(request.domain, request.descriptors[i])
+            if self.over_limit_cache.hit(stem):
+                with self._health_lock:
+                    self.stat_degraded_denials += 1
+                return OVER
+        return OK
+
+    def _note_degraded(self, request, n: int) -> None:
+        with self._health_lock:
+            self.stat_fallback_descriptors += n
+        if self.flight is not None and n:
+            self.flight.record(request.domain, self._fc_degraded, n, 0.0)
+
+    def _fallback_response(self, request) -> rls_pb2.RateLimitResponse:
+        """Every-replica-unreachable answer per the failure policy."""
+        n = len(request.descriptors)
+        self._note_degraded(request, n)
+        OVER = rls_pb2.RateLimitResponse.OVER_LIMIT
+        OK = rls_pb2.RateLimitResponse.OK
+        out = rls_pb2.RateLimitResponse(overall_code=OK)
+        for i in range(n):
+            code = self._fallback_code(request, i)
             out.statuses.add().code = code
+            if code == OVER:
+                out.overall_code = OVER
         return out
+
+    def _feed_over_limit_cache(self, request, rows, sub_resp) -> None:
+        """Remember healthy OVER_LIMIT verdicts (with a TTL of one
+        window of the limit that produced them) for degraded-mode
+        denials later.  Only wired when failure_policy=local-cache."""
+        OVER = rls_pb2.RateLimitResponse.OVER_LIMIT
+        for j, i in enumerate(rows):
+            st = sub_resp.statuses[j]
+            if st.code != OVER:
+                continue
+            ttl = _UNIT_TTL_S.get(st.current_limit.unit, 60.0)
+            self.over_limit_cache.put(
+                routing_key(request.domain, request.descriptors[i]), ttl
+            )
 
     def should_rate_limit(
         self,
@@ -543,7 +757,7 @@ class ReplicaRouter:
                 "no live replicas (all %d ejected); failure policy %r "
                 "answers", len(self.replica_ids), self.failure_policy,
             )
-            return self._fallback_response(n)
+            return self._fallback_response(request)
 
         if n == 0:
             # A replica answers the empty/error case so the wire
@@ -614,7 +828,7 @@ class ReplicaRouter:
                             raise
                         remaining()
                         continue
-                return self._fallback_response(0)
+                return self._fallback_response(request)
             finally:
                 self._release_probes(untouched)
 
@@ -657,8 +871,7 @@ class ReplicaRouter:
                     with self._health_lock:
                         self.stat_failovers += ok_retries
             if fallback_rows:
-                with self._health_lock:
-                    self.stat_fallback_descriptors += len(fallback_rows)
+                self._note_degraded(request, len(fallback_rows))
 
         # Merge: statuses back to request order; overall code is the
         # logical OR (service/ratelimit.go:185-190); headers follow
@@ -676,6 +889,8 @@ class ReplicaRouter:
         statuses = [None] * n
         best_hdr = None  # ((remaining, not_over), sub_response)
         for rows, sub_resp in results:
+            if self.over_limit_cache is not None:
+                self._feed_over_limit_cache(request, rows, sub_resp)
             if sub_resp.overall_code == OVER:
                 out.overall_code = OVER
             for j, i in enumerate(rows):
@@ -695,17 +910,14 @@ class ReplicaRouter:
                         best_hdr = (rank, sub_resp)
         if fallback_rows:
             # Policy answer for descriptors no live replica could
-            # serve: "open" admits them (plain OK, no limit attached —
-            # the same shape as a no-matching-rule descriptor),
-            # "closed" denies them and forces the overall code.
-            code = (
-                rls_pb2.RateLimitResponse.OK
-                if self.failure_policy == "open"
-                else OVER
-            )
-            if code == OVER:
-                out.overall_code = OVER
+            # serve: "allow" admits them (plain OK, no limit attached —
+            # the same shape as a no-matching-rule descriptor), "deny"
+            # denies and forces the overall code, "local-cache" denies
+            # only the stems recently seen over limit.
             for i in fallback_rows:
+                code = self._fallback_code(request, i)
+                if code == OVER:
+                    out.overall_code = OVER
                 st = rls_pb2.RateLimitResponse.DescriptorStatus()
                 st.code = code
                 statuses[i] = st

@@ -103,6 +103,16 @@ MAX_DOMAINS = 256
 #: Outside the api.Code range (0..2) on purpose.
 FLIGHT_CODE_SHED = 8
 
+#: Cluster-tier sentinels (cluster/router.py stamps them when built
+#: with a recorder): DEGRADED marks descriptors answered by the
+#: CLUSTER_FAILURE_MODE policy because no live replica could serve
+#: them (``hits`` carries how many); FORWARDED marks descriptors
+#: routed to their OLD owner during a membership-change forwarding
+#: window (cluster/handoff.py).  Same outside-the-protocol rationale
+#: as FLIGHT_CODE_SHED.
+FLIGHT_CODE_DEGRADED = 9
+FLIGHT_CODE_FORWARDED = 10
+
 
 class _Note(threading.local):
     """Per-thread (stem_hash, lane) deposit from the backend's request
@@ -292,6 +302,10 @@ class FlightRecorder:
                 # annotate so readers never mistake the sentinel for a
                 # protocol code.
                 d["shed"] = True
+            elif code == FLIGHT_CODE_DEGRADED:
+                d["degraded"] = True
+            elif code == FLIGHT_CODE_FORWARDED:
+                d["forwarded"] = True
             out.append(d)
         return out
 

@@ -489,6 +489,7 @@ class Runner:
             slo=self.slo,
             overload=self.overload,
             flight=self.flight,
+            cluster_handoff_enabled=s.cluster_handoff_enabled,
         )
         add_healthcheck(self.debug_server, self.health)
         self.debug_server.start()

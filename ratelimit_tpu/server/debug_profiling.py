@@ -252,6 +252,11 @@ ENDPOINT_BLURBS = {
         "flight-ring capture ?format=jsonl|json — replay harness "
         "input (DEBUG_PROFILING=1)"
     ),
+    "/debug/cluster": (
+        "this replica's counter-handoff summary + ratelimit.cluster.* "
+        "state (JSON; admin POSTs under it need "
+        "CLUSTER_HANDOFF_ENABLED=1)"
+    ),
     "/debug/threadz": "all-thread stack dump",
     "/debug/profile": (
         "statistical CPU profile ?seconds=N (DEBUG_PROFILING=1)"

@@ -249,13 +249,17 @@ def add_debug_routes(
     slo=None,
     overload=None,
     flight=None,
+    cluster_handoff_enabled: bool = False,
 ) -> None:
     """/stats, /rlconfig, /metrics, /debug/* (server_impl.go:254-261,
     runner.go:117-124).  ``profiling_enabled`` (the DEBUG_PROFILING
     setting) opens the capture endpoints in debug_profiling.py AND the
     flight-ring capture at /debug/flight; ``detectors``/``slo``
     (observability/) open /debug/incidents and /debug/slo;
-    ``overload`` (overload/controller.py) opens /debug/overload."""
+    ``overload`` (overload/controller.py) opens /debug/overload;
+    ``cluster_handoff_enabled`` (CLUSTER_HANDOFF_ENABLED) opens the
+    counter-handoff admin POSTs under /debug/cluster (the GET summary
+    is always on)."""
 
     def stats(h) -> None:
         lines = []
@@ -416,10 +420,107 @@ def add_debug_routes(
         body = "".join(json.dumps(r) + "\n" for r in records)
         h._reply(200, body.encode(), content_type="application/x-ndjson")
 
+    def _handoff_cache(h):
+        """The cache behind the handoff surface, or None (replied)."""
+        cache = getattr(service, "cache", None)
+        if cache is None or not hasattr(cache, "handoff_log"):
+            h._reply(
+                404,
+                b"no cluster-handoff-capable backend (tpu/tpu-sharded "
+                b"only)\n",
+            )
+            return None
+        return cache
+
+    def cluster_view(h) -> None:
+        # Cluster zPage (docs/MULTI_REPLICA.md): THIS replica's
+        # handoff bookkeeping — what moved in/out and when.  The
+        # routing half (per-replica circuits, degraded counters) lives
+        # on the proxy's --debug-port /debug/cluster.
+        cache = getattr(service, "cache", None)
+        log = getattr(cache, "handoff_log", None)
+        body = {
+            "handoff_enabled": cluster_handoff_enabled,
+            "handoff": None if log is None else log.snapshot(),
+        }
+        h._reply(
+            200,
+            json.dumps(body, default=str).encode(),
+            content_type="application/json",
+        )
+
+    def _gate_handoff(h) -> bool:
+        if not cluster_handoff_enabled:
+            h._reply(
+                403,
+                b"cluster handoff is disabled; start the replica with "
+                b"CLUSTER_HANDOFF_ENABLED=1 to open the export/import "
+                b"admin endpoints\n",
+            )
+            return False
+        return True
+
+    def _read_body(h) -> bytes:
+        return h.rfile.read(int(h.headers.get("Content-Length", "0") or 0))
+
+    def cluster_export(h) -> None:
+        # Counter-handoff export (cluster/handoff.py): body names the
+        # NEW membership and this replica's cluster identity; the
+        # reply is the packed key ranges this replica no longer owns
+        # (which also LEAVE this replica — the proxy's forwarding
+        # window covers the gap).
+        if not _gate_handoff(h):
+            return
+        cache = _handoff_cache(h)
+        if cache is None:
+            return
+        from ..cluster import handoff as _handoff
+
+        try:
+            req = json.loads(_read_body(h).decode("utf-8"))
+            membership = list(req["membership"])
+            self_id = req["self"]
+            drop = bool(req.get("drop", True))
+        except Exception as e:
+            h._reply(400, f"bad export request: {e}\n".encode())
+            return
+        sections = _handoff.export_from_cache(
+            cache, membership, self_id, drop=drop
+        )
+        h._reply(
+            200,
+            _handoff.pack_sections(sections),
+            content_type="application/octet-stream",
+        )
+
+    def cluster_import(h) -> None:
+        # Counter-handoff import: the packed sections land in this
+        # replica's banks (lane re-routing + merge-on-collision —
+        # see cluster/handoff.py import_into_cache).
+        if not _gate_handoff(h):
+            return
+        cache = _handoff_cache(h)
+        if cache is None:
+            return
+        from ..cluster import handoff as _handoff
+
+        try:
+            sections = _handoff.unpack_sections(_read_body(h))
+        except Exception as e:
+            h._reply(400, f"bad handoff blob: {e}\n".encode())
+            return
+        res = _handoff.import_into_cache(cache, sections)
+        h._reply(
+            200, json.dumps(res).encode(), content_type="application/json"
+        )
+
     server.add_route("GET", "/debug/incidents", incidents)
     server.add_route("GET", "/debug/slo", slo_summary)
     server.add_route("GET", "/debug/overload", overload_view)
     server.add_route("GET", "/debug/flight", flight_dump)
+    server.add_route("GET", "/debug/cluster", cluster_view)
+    server.add_route("POST", "/debug/cluster/export", cluster_export)
+    server.add_route("POST", "/debug/cluster/import", cluster_import)
 
     if service is not None:
 

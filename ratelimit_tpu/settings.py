@@ -325,6 +325,25 @@ class Settings:
     trace_export_jsonl: str = ""
     trace_log: bool = False
 
+    # Cluster tier (cluster/; docs/MULTI_REPLICA.md).
+    # CLUSTER_HANDOFF_ENABLED opens the replica's counter-handoff
+    # admin surface on the DEBUG listener (POST /debug/cluster/export
+    # + /debug/cluster/import): the proxy's membership-change
+    # coordinator exports the key ranges a replica no longer owns and
+    # imports them into the new owner, so moved counters never reset.
+    # Off by default — the import endpoint WRITES counter state, so
+    # like /debug/profile it is an operator opt-in, and the debug
+    # listener must stay on a management interface.
+    cluster_handoff_enabled: bool = False
+    # CLUSTER_FAILURE_MODE is consumed by the PROXY process
+    # (cluster/proxy.py --failure-mode default): what descriptors get
+    # when no live replica can serve them — allow | deny |
+    # local-cache (deny only keys recently over limit, the
+    # reference's FAILURE_MODE_DENY + freecache over-limit cache
+    # semantics).  Declared here so the cluster env surface is
+    # documented in one place.
+    cluster_failure_mode: str = "allow"
+
     # Global shadow mode (settings.go:105).
     global_shadow_mode: bool = False
 
@@ -428,6 +447,8 @@ def new_settings() -> Settings:
         trace_slow_size=_env_int("TRACE_SLOW_SIZE", 32),
         trace_export_jsonl=_env_str("TRACE_EXPORT_JSONL", ""),
         trace_log=_env_bool("TRACE_LOG", False),
+        cluster_handoff_enabled=_env_bool("CLUSTER_HANDOFF_ENABLED", False),
+        cluster_failure_mode=_env_str("CLUSTER_FAILURE_MODE", "allow"),
         global_shadow_mode=_env_bool("SHADOW_MODE", False),
     )
     return s

@@ -1,0 +1,240 @@
+"""Cluster smoke (make cluster-smoke): the elastic-tier happy path in
+a few seconds, wired into `make ci`.
+
+Boots TWO in-process replicas (full TpuRateLimitCache +
+RateLimitService stacks with their real debug HTTP listeners) behind
+the proxy's RouterHolder, then:
+
+1. enforces one limit jointly through the router;
+2. KILLS one replica (cluster/faults.py): asserts ejection, in-request
+   failover, and — after killing the second too — the degraded-mode
+   CLUSTER_FAILURE_MODE answer (local-cache: known-over key denied,
+   cold key admitted);
+3. heals, then ADDS a third replica via RouterHolder.swap with the
+   handoff coordinator driving the REAL HTTP admin endpoints
+   (POST /debug/cluster/export|import, CLUSTER_HANDOFF_ENABLED
+   semantics): asserts the moved counter did NOT restart its window
+   and the ratelimit.cluster.* handoff counters moved.
+
+Run:  JAX_PLATFORMS=cpu python scripts/cluster_smoke.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ratelimit_tpu.backends.engine import CounterEngine  # noqa: E402
+from ratelimit_tpu.backends.tpu_cache import TpuRateLimitCache  # noqa: E402
+from ratelimit_tpu.cluster.faults import FaultInjector  # noqa: E402
+from ratelimit_tpu.cluster.handoff import (  # noqa: E402
+    HandoffCoordinator,
+    HttpAdminTransport,
+)
+from ratelimit_tpu.cluster.hashing import owner_id  # noqa: E402
+from ratelimit_tpu.cluster.proxy import RouterHolder  # noqa: E402
+from ratelimit_tpu.cluster.router import ReplicaRouter  # noqa: E402
+from ratelimit_tpu.server.codec import (  # noqa: E402
+    request_from_pb,
+    response_to_pb,
+)
+from ratelimit_tpu.server.http_server import (  # noqa: E402
+    HttpServer,
+    add_debug_routes,
+)
+from ratelimit_tpu.service import RateLimitService  # noqa: E402
+from ratelimit_tpu.stats.manager import Manager  # noqa: E402
+from ratelimit_tpu.utils.time import PinnedTimeSource  # noqa: E402
+
+from ratelimit_tpu.server import pb  # noqa: F401,E402
+from envoy.service.ratelimit.v3 import rls_pb2  # noqa: E402
+
+YAML = (
+    "domain: smoke\n"
+    "descriptors:\n"
+    "  - key: k\n"
+    "    rate_limit:\n"
+    "      unit: minute\n"
+    "      requests_per_unit: 5\n"
+)
+
+OK = rls_pb2.RateLimitResponse.OK
+OVER = rls_pb2.RateLimitResponse.OVER_LIMIT
+
+
+class _Runtime:
+    def __init__(self, files):
+        self.files = files
+
+    def snapshot(self):
+        files = self.files
+
+        class Snap:
+            def keys(self):
+                return list(files)
+
+            def get(self, key):
+                return files[key]
+
+        return Snap()
+
+    def add_update_callback(self, fn):
+        pass
+
+
+class Replica:
+    def __init__(self, clock):
+        self.cache = TpuRateLimitCache(
+            CounterEngine(num_slots=1 << 10, buckets=(8, 32)), clock
+        )
+        self.service = RateLimitService(
+            _Runtime({"config.smoke": YAML}), self.cache, Manager()
+        )
+        self.manager = Manager()
+        self.debug = HttpServer("127.0.0.1", 0, name="smoke-debug")
+        add_debug_routes(
+            self.debug,
+            self.manager.store,
+            self.service,
+            cluster_handoff_enabled=True,
+        )
+        self.debug.start()
+
+    @property
+    def admin_url(self):
+        return f"http://127.0.0.1:{self.debug.bound_port}"
+
+    def transport(self):
+        def call(req, timeout_s=None):
+            return response_to_pb(
+                self.service.should_rate_limit(request_from_pb(req))
+            )
+
+        return call
+
+    def stop(self):
+        self.debug.stop()
+        self.cache.close()
+
+
+def pb_request(value):
+    req = rls_pb2.RateLimitRequest(domain="smoke")
+    d = req.descriptors.add()
+    e = d.entries.add()
+    e.key, e.value = "k", value
+    return req
+
+
+def check(name, cond):
+    print(f"{'ok  ' if cond else 'FAIL'} {name}")
+    if not cond:
+        raise SystemExit(f"cluster smoke failed: {name}")
+
+
+def main() -> int:
+    clock = PinnedTimeSource(1_700_000_020)
+    ids2 = ["r1", "r2"]
+    ids3 = ["r1", "r2", "r3"]
+    replicas = {rid: Replica(clock) for rid in ids3}
+    faults = FaultInjector()
+
+    def make_router(ids):
+        return ReplicaRouter(
+            ids,
+            [faults.wrap(rid, replicas[rid].transport()) for rid in ids],
+            eject_after=2,
+            readmit_after_s=60.0,
+            failure_policy="local-cache",
+            retry_max=1,
+            retry_base_s=0.001,
+        )
+
+    admins = {rid: HttpAdminTransport(r.admin_url) for rid, r in replicas.items()}
+    holder = RouterHolder(
+        make_router(ids2), handoff=HandoffCoordinator(admins.get).run
+    )
+    try:
+        # A key that will MOVE to r3 when it joins (and is owned by a
+        # survivor now, so its counter can travel).
+        target = next(
+            f"t{i}"
+            for i in range(10_000)
+            if owner_id(f"smoke_k_t{i}_", ids3) == "r3"
+        )
+        codes = [
+            holder.should_rate_limit(pb_request(target)).overall_code
+            for _ in range(6)
+        ]
+        check(
+            "two replicas jointly enforce one 5/min limit",
+            codes == [OK] * 5 + [OVER],
+        )
+
+        # Kill r2 mid-stream: its keys fail over to r1, the circuit
+        # opens after eject_after failures.
+        faults.kill("r2")
+        for i in range(10):
+            holder.should_rate_limit(pb_request(f"spread{i}"))
+        st = holder.stats()
+        check("killed replica ejected", st["ejections"] >= 1)
+        check("in-request failover served its keys", st["failovers"] >= 1)
+        check(
+            "per-replica circuit state exposed",
+            {s["id"]: s["state"] for s in st["replica_states"]}["r1"]
+            == "closed",
+        )
+
+        # Kill r1 too: NO live replica — the degraded failure mode
+        # answers.  local-cache: the known-over target is denied, a
+        # cold key is admitted.
+        faults.kill("r1")
+        for i in range(4):  # burn through ejection threshold
+            holder.should_rate_limit(pb_request("burn"))
+        hot = holder.should_rate_limit(pb_request(target)).overall_code
+        cold = holder.should_rate_limit(pb_request("cold-key")).overall_code
+        check(
+            "degraded local-cache mode: known-over denied, cold admitted",
+            hot == OVER and cold == OK,
+        )
+        st = holder.stats()
+        check(
+            "degraded counters on /stats.json",
+            st["fallback_descriptors"] >= 2 and st["degraded_denials"] >= 1,
+        )
+
+        # Heal and JOIN r3 with counter handoff over the real HTTP
+        # admin endpoints: the target's counter moves, so the 5/min
+        # window does NOT restart — the first request on the new
+        # owner is still OVER.
+        faults.heal()
+        holder.swap(make_router(ids3), grace_s=0.5)
+        deadline = time.monotonic() + 10.0
+        while holder.last_handoff is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        check("handoff completed", holder.last_handoff is not None)
+        check(
+            "handoff moved keys",
+            holder.last_handoff["imported"] + holder.last_handoff["merged"]
+            >= 1,
+        )
+        check(
+            "moved key did not restart its window",
+            holder.should_rate_limit(pb_request(target)).overall_code
+            == OVER,
+        )
+        snap = replicas["r3"].cache.handoff_log.snapshot()
+        check(
+            "ratelimit.cluster.* handoff counters moved on the joiner",
+            snap["imported_keys"] + snap["merged_keys"] >= 1,
+        )
+        print("cluster smoke: all checks passed")
+        return 0
+    finally:
+        holder.close()
+        for r in replicas.values():
+            r.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -107,3 +107,37 @@ def test_checkpoint_manager_with_dispatcher(tmp_path, clock):
         assert _hit(fresh, rule, 3) == [Code.OK, Code.OK, Code.OVER_LIMIT]
     finally:
         cache.close()
+
+
+def test_restore_refuses_stale_snapshot(tmp_path, clock):
+    """Restore-age guard: a snapshot older than the longest window
+    unit (one day) is refused — every counter in it expired, and
+    restoring would resurrect dead windows.  The wall clock is a seam
+    (FakeMonotonicClock) so the test needs no real day."""
+    from ratelimit_tpu.backends.checkpoint import MAX_RESTORE_AGE_S
+    from ratelimit_tpu.utils.time import FakeMonotonicClock
+
+    path = str(tmp_path / "bank0.npz")
+    cache_a = TpuRateLimitCache(CounterEngine(num_slots=64), time_source=clock)
+    rule = _rule(Manager())
+    assert _hit(cache_a, rule, 5) == [Code.OK] * 5
+    import time as _time
+
+    saved_at = _time.time()
+    save_engine(cache_a.engine, path)
+
+    # Within the age bound: restores, window still enforced.
+    wall = FakeMonotonicClock(saved_at + 60.0)
+    fresh = CounterEngine(num_slots=64)
+    assert restore_engine(fresh, path, wall_now=wall.now) is True
+    assert len(fresh.slot_table) == 1
+
+    # Older than the longest window unit: refused, engine stays fresh.
+    wall.advance(MAX_RESTORE_AGE_S + 120.0)
+    stale = CounterEngine(num_slots=64)
+    assert restore_engine(stale, path, wall_now=wall.now) is False
+    assert len(stale.slot_table) == 0
+
+    # max_age_s=0 disables the guard (operator override).
+    assert restore_engine(stale, path, max_age_s=0, wall_now=wall.now) is True
+    assert len(stale.slot_table) == 1

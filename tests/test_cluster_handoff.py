@@ -1,0 +1,815 @@
+"""Elastic cluster tier: counter handoff, degraded-mode routing and
+fault injection (docs/MULTI_REPLICA.md "Counter handoff").
+
+Three layers:
+- engine/cache handoff mechanics: export-by-ownership-predicate,
+  lane re-routing on import, merge-on-collision, stale drops — the
+  core "no counter resets" property asserted via do_limit continuity;
+- the coordinator + admin transports (in-process and over a real
+  debug HTTP listener, the wire the proxy drives);
+- degraded routing: the CLUSTER_FAILURE_MODE matrix
+  (allow/deny/local-cache), bounded retry with backoff vs the
+  caller's absolute deadline, the forwarding window, fault modes.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ratelimit_tpu.api import Code, Descriptor, RateLimitRequest, Unit
+from ratelimit_tpu.backends import CounterEngine, TpuRateLimitCache
+from ratelimit_tpu.cluster import handoff as ho
+from ratelimit_tpu.cluster.faults import FaultInjector, FaultStatusError
+from ratelimit_tpu.cluster.hashing import (
+    owner_id,
+    routing_key,
+    stem_of_cache_key,
+)
+from ratelimit_tpu.cluster.router import ReplicaRouter
+from ratelimit_tpu.utils.time import PinnedTimeSource
+
+from ratelimit_tpu.server import pb  # noqa: F401
+from envoy.service.ratelimit.v3 import rls_pb2  # noqa: E402
+
+NOW = 1_700_000_000  # mid-window nowhere near a minute rollover
+
+
+def make_cache(n_lanes=1, per_second=False, clock=None, prefix=""):
+    lanes = [
+        CounterEngine(num_slots=1 << 10, buckets=(8, 32))
+        for _ in range(n_lanes)
+    ]
+    ps = (
+        CounterEngine(num_slots=1 << 10, buckets=(8, 32))
+        if per_second
+        else None
+    )
+    return TpuRateLimitCache(
+        lanes if n_lanes > 1 else lanes[0],
+        clock or PinnedTimeSource(NOW),
+        per_second_engine=ps,
+        cache_key_prefix=prefix,
+    )
+
+
+def make_rule(manager, key="domain.key_value", rpu=10, unit=Unit.MINUTE):
+    from ratelimit_tpu.api import RateLimit
+    from ratelimit_tpu.config import RateLimitRule
+
+    return RateLimitRule(
+        full_key=key,
+        limit=RateLimit(rpu, unit),
+        stats=manager.rate_limit_stats(key),
+    )
+
+
+def hit(cache, rule, desc, times=1, hits=0):
+    codes = []
+    for _ in range(times):
+        [st] = cache.do_limit(
+            RateLimitRequest("domain", [desc], hits), [rule]
+        )
+        codes.append(st.code)
+    return codes
+
+
+def stem_for(desc, domain="domain", prefix=""):
+    from ratelimit_tpu.limiter.cache_key import build_stem
+
+    return build_stem(prefix, domain, desc.entries)
+
+
+# -- hashing ----------------------------------------------------------
+
+
+def test_stem_of_cache_key_strips_window_and_prefix():
+    assert stem_of_cache_key("d_k_v_1700000040") == "d_k_v_"
+    assert stem_of_cache_key("p:d_k_v_1700000040", "p:") == "d_k_v_"
+    # Values with underscores: only the LAST token is the window.
+    assert stem_of_cache_key("d_k_a_b_9_1700000040") == "d_k_a_b_9_"
+    # Stable-stem keys (algorithm banks) have no window suffix.
+    assert stem_of_cache_key("d_k_v_") == "d_k_v_"
+
+
+# -- engine/cache export + import ------------------------------------
+
+
+def test_handoff_preserves_counter_no_window_restart(stats_manager):
+    """The tentpole property: a key moved between replicas keeps its
+    count — 6 hits before the move + 4 after hit the 10/min limit
+    exactly; hit 11 is OVER on the NEW owner."""
+    a, b = make_cache(), make_cache()
+    rule = make_rule(stats_manager)
+    desc = Descriptor.of(("key", "value"))
+    assert hit(a, rule, desc, 6) == [Code.OK] * 6
+
+    sections = ho.export_from_cache(a, ["B"], "A")  # everything moves
+    assert sum(len(s["keys"]) for s in sections) == 1
+    res = ho.import_into_cache(b, sections)
+    assert res["imported"] == 1 and res["dropped"] == 0
+
+    codes = hit(b, rule, desc, 5)
+    assert codes == [Code.OK] * 4 + [Code.OVER_LIMIT]
+    # The old owner DROPPED the key (export is a move, not a copy):
+    # a request landing there starts a fresh window.
+    [st] = a.do_limit(RateLimitRequest("domain", [desc], 0), [rule])
+    assert st.code == Code.OK
+    assert st.limit_remaining == 9
+    # Bookkeeping surfaced for /debug/cluster + ratelimit.cluster.*.
+    assert a.handoff_log.snapshot()["exported_keys"] == 1
+    assert b.handoff_log.snapshot()["imported_keys"] == 1
+
+
+def test_export_is_ownership_selective(stats_manager):
+    """Only keys whose new owner differs leave; the predicate runs on
+    prefix-stripped stems, byte-identical to proxy routing."""
+    a = make_cache()
+    rule = make_rule(stats_manager)
+    membership = ["A", "B"]
+    mine, moved = [], []
+    for i in range(40):
+        d = Descriptor.of(("key", f"v{i}"))
+        (mine if owner_id(stem_for(d), membership) == "A" else moved).append(d)
+    assert mine and moved
+    for d in mine + moved:
+        hit(a, rule, d, 1)
+    sections = ho.export_from_cache(a, membership, "A")
+    exported = {k for s in sections for k in s["stems"]}
+    assert exported == {stem_for(d) for d in moved}
+
+
+def test_import_merges_counts_when_both_sides_counted(stats_manager):
+    """A key the new owner already counted during the transfer window
+    MERGES by addition: 6 (old) + 3 (new) = 9 -> one more OK, then
+    OVER.  Admission never double-grants the window."""
+    a, b = make_cache(), make_cache()
+    rule = make_rule(stats_manager)
+    desc = Descriptor.of(("key", "value"))
+    hit(a, rule, desc, 6)
+    hit(b, rule, desc, 3)
+    sections = ho.export_from_cache(a, ["B"], "A")
+    res = ho.import_into_cache(b, sections)
+    assert res["merged"] == 1 and res["imported"] == 0
+    assert hit(b, rule, desc, 2) == [Code.OK, Code.OVER_LIMIT]
+
+
+def test_import_drops_expired_entries(stats_manager):
+    """A stale handoff blob cannot resurrect expired counters: entries
+    whose lease passed are dropped and the key starts fresh."""
+    clock_b = PinnedTimeSource(NOW)
+    a, b = make_cache(), make_cache(clock=clock_b)
+    rule = make_rule(stats_manager)
+    desc = Descriptor.of(("key", "value"))
+    hit(a, rule, desc, 10)
+    sections = ho.export_from_cache(a, ["B"], "A")
+    clock_b.advance(3600)  # way past the minute window's lease
+    res = ho.import_into_cache(b, sections)
+    assert res["dropped"] == 1 and res["imported"] == 0
+    [st] = b.do_limit(RateLimitRequest("domain", [desc], 0), [rule])
+    assert st.code == Code.OK  # fresh, not resurrected-over
+
+
+def test_import_reroutes_to_local_lanes(stats_manager):
+    """A 1-lane export imported into a 2-lane replica lands each key
+    on the lane the SERVING path hashes it to — the very next request
+    finds its counter."""
+    a, b = make_cache(n_lanes=1), make_cache(n_lanes=2)
+    rule = make_rule(stats_manager)
+    descs = [Descriptor.of(("key", f"v{i}")) for i in range(16)]
+    for d in descs:
+        hit(a, rule, d, 6)
+    ho.import_into_cache(b, ho.export_from_cache(a, ["B"], "A"))
+    # Counters continued on b for every key, whatever lane it hashed to.
+    for d in descs:
+        assert hit(b, rule, d, 5) == [Code.OK] * 4 + [Code.OVER_LIMIT]
+    # And both lanes actually hold keys (the split happened).
+    assert len(b.lanes[0].slot_table) > 0
+    assert len(b.lanes[1].slot_table) > 0
+
+
+def test_import_routes_per_second_bank(stats_manager):
+    a = make_cache(per_second=True)
+    b = make_cache(per_second=True)
+    rule = make_rule(stats_manager, rpu=10, unit=Unit.SECOND)
+    desc = Descriptor.of(("key", "value"))
+    hit(a, rule, desc, 6)
+    sections = ho.export_from_cache(a, ["B"], "A")
+    assert [s["role"] for s in sections] == ["per_second"]
+    ho.import_into_cache(b, sections)
+    assert hit(b, rule, desc, 5) == [Code.OK] * 4 + [Code.OVER_LIMIT]
+
+
+def test_import_drops_sections_with_no_matching_bank(stats_manager):
+    """A per-second section arriving at a replica without a per-second
+    bank is dropped with a count — never mis-imported into a lane."""
+    a = make_cache(per_second=True)
+    b = make_cache(per_second=False)
+    rule = make_rule(stats_manager, rpu=10, unit=Unit.SECOND)
+    hit(a, rule, Descriptor.of(("key", "value")), 3)
+    res = ho.import_into_cache(b, ho.export_from_cache(a, ["B"], "A"))
+    assert res["dropped"] == 1 and res["imported"] == 0
+
+
+def test_import_refuses_algorithm_mismatch(stats_manager):
+    """Kernel state is not interchangeable (the checkpoint-restore
+    guard applied to handoff): a section stamped with a different
+    algorithm than the target bank is dropped."""
+    b = make_cache()
+    sec = {
+        "role": "lane0of1",
+        "algorithm": "gcra",
+        "prefix": "",
+        "keys": ["domain_key_value_1700000040"],
+        "stems": ["domain_key_value_"],
+        "expiries": np.array([NOW + 600], dtype=np.int64),
+        "state": {"counts": np.array([5], dtype=np.uint32)},
+    }
+    res = ho.import_into_cache(b, [sec])
+    assert res["dropped"] == 1 and res["imported"] == 0
+
+
+# -- wire format + partitioning ---------------------------------------
+
+
+def test_pack_unpack_roundtrip(stats_manager):
+    a = make_cache(prefix="px:")
+    rule = make_rule(stats_manager)
+    for i in range(5):
+        hit(a, rule, Descriptor.of(("key", f"v{i}")), i + 1)
+    sections = ho.export_from_cache(a, ["B"], "A")
+    back = ho.unpack_sections(ho.pack_sections(sections))
+    assert len(back) == len(sections)
+    for s0, s1 in zip(sections, back):
+        assert s0["keys"] == s1["keys"]
+        assert s0["stems"] == s1["stems"]  # prefix survived the wire
+        assert s0["role"] == s1["role"]
+        np.testing.assert_array_equal(
+            np.asarray(s0["expiries"]), np.asarray(s1["expiries"])
+        )
+        for name in s0["state"]:
+            np.testing.assert_array_equal(
+                np.asarray(s0["state"][name]), np.asarray(s1["state"][name])
+            )
+
+
+def test_unpack_rejects_unknown_version():
+    blob = ho.pack_sections([])
+    # Corrupt the version by rebuilding meta: simplest is a new blob
+    # with hand-made meta.
+    import io
+
+    meta = {"version": 99, "sections": []}
+    buf = io.BytesIO()
+    np.savez_compressed(
+        buf,
+        meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+    )
+    with pytest.raises(ValueError):
+        ho.unpack_sections(buf.getvalue())
+    assert ho.unpack_sections(blob) == []
+
+
+def test_partition_sections_by_new_owner():
+    new_ids = ["A", "B", "C"]
+    stems = [f"d_k_v{i}_" for i in range(30)]
+    sec = {
+        "role": "lane0of1",
+        "algorithm": "fixed_window",
+        "prefix": "",
+        "keys": [s + "123" for s in stems],
+        "stems": stems,
+        "expiries": np.arange(30, dtype=np.int64),
+        "state": {"counts": np.arange(30, dtype=np.uint32)},
+    }
+    parts = ho.partition_sections([sec], new_ids)
+    seen = {}
+    for target, tsections in parts.items():
+        for ts in tsections:
+            for stem, cnt in zip(ts["stems"], ts["state"]["counts"]):
+                assert owner_id(stem, new_ids) == target
+                seen[stem] = int(cnt)
+    # Every entry landed exactly once, state column attached.
+    assert seen == {s: i for i, s in enumerate(stems)}
+
+
+# -- coordinator ------------------------------------------------------
+
+
+def test_coordinator_moves_keys_to_their_new_owner(stats_manager):
+    """Join scenario: [A,B] -> [A,B,C].  Keys whose owner becomes C
+    leave A and B with their counts; everything else stays put."""
+    caches = {rid: make_cache() for rid in ("A", "B", "C")}
+    rule = make_rule(stats_manager)
+    old_ids, new_ids = ["A", "B"], ["A", "B", "C"]
+    moved = []
+    for i in range(60):
+        d = Descriptor.of(("key", f"v{i}"))
+        stem = stem_for(d)
+        owner_old = owner_id(stem, old_ids)
+        hit(caches[owner_old], rule, d, 6)
+        if owner_id(stem, new_ids) == "C":
+            moved.append(d)
+    assert moved  # rendezvous moves ~1/3
+    admins = {rid: ho.LocalAdminTransport(c) for rid, c in caches.items()}
+    summary = ho.HandoffCoordinator(admins.get).run(old_ids, new_ids)
+    assert summary["moved_keys"] == len(moved)
+    assert summary["imported"] == len(moved)
+    assert summary["errors"] == []
+    for d in moved:
+        assert hit(caches["C"], rule, d, 5) == [Code.OK] * 4 + [
+            Code.OVER_LIMIT
+        ]
+
+
+def test_coordinator_survives_dead_exporter(stats_manager):
+    """A dead old owner (no admin / export raises) degrades to the
+    pre-handoff envelope: its keys are skipped, the rest still move,
+    errors are recorded."""
+    a, c = make_cache(), make_cache()
+    rule = make_rule(stats_manager)
+    hit(a, rule, Descriptor.of(("key", "v1")), 3)
+
+    def boom(membership, self_id):
+        raise OSError("connection refused")
+
+    class DeadAdmin(ho.AdminTransport):
+        export = staticmethod(boom)
+
+    admins = {
+        "A": ho.LocalAdminTransport(a),
+        "B": DeadAdmin(),
+        "C": ho.LocalAdminTransport(c),
+    }
+    summary = ho.HandoffCoordinator(admins.get).run(["A", "B"], ["C"])
+    assert any("export from B failed" in e for e in summary["errors"])
+    assert summary["moved_keys"] >= 1  # A's keys still moved
+
+
+# -- admin surface over the real debug listener ----------------------
+
+
+class _ServiceStub:
+    def __init__(self, cache):
+        self.cache = cache
+
+    def get_current_config(self):
+        return None
+
+
+def _debug_server(cache, enabled=True):
+    from ratelimit_tpu.server.http_server import HttpServer, add_debug_routes
+    from ratelimit_tpu.stats.manager import Manager
+
+    srv = HttpServer("127.0.0.1", 0, name="debug-test")
+    add_debug_routes(
+        srv,
+        Manager().store,
+        _ServiceStub(cache),
+        cluster_handoff_enabled=enabled,
+    )
+    srv.start()
+    return srv
+
+
+def test_http_admin_roundtrip_and_debug_cluster(stats_manager):
+    """The proxy-driven wire: export from A over HTTP, import into B
+    over HTTP, counters continue; GET /debug/cluster reflects both."""
+    a, b = make_cache(), make_cache()
+    rule = make_rule(stats_manager)
+    desc = Descriptor.of(("key", "value"))
+    hit(a, rule, desc, 6)
+    sa, sb = _debug_server(a), _debug_server(b)
+    try:
+        ta = ho.HttpAdminTransport(f"http://127.0.0.1:{sa.bound_port}")
+        tb = ho.HttpAdminTransport(f"http://127.0.0.1:{sb.bound_port}")
+        sections = ta.export(["B"], "A")
+        res = tb.import_(sections)
+        assert res["imported"] == 1
+        assert hit(b, rule, desc, 5) == [Code.OK] * 4 + [Code.OVER_LIMIT]
+        view = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{sb.bound_port}/debug/cluster", timeout=5
+            ).read()
+        )
+        assert view["handoff_enabled"] is True
+        assert view["handoff"]["imported_keys"] == 1
+        assert view["handoff"]["last_import"]["imported"] == 1
+    finally:
+        sa.stop()
+        sb.stop()
+
+
+def test_admin_posts_gated_by_setting(stats_manager):
+    """CLUSTER_HANDOFF_ENABLED=0 (the default): the WRITE surface
+    answers 403; the GET summary stays open."""
+    srv = _debug_server(make_cache(), enabled=False)
+    try:
+        base = f"http://127.0.0.1:{srv.bound_port}"
+        body = json.dumps({"membership": ["B"], "self": "A"}).encode()
+        req = urllib.request.Request(
+            base + "/debug/cluster/export", data=body, method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 403
+        view = json.loads(
+            urllib.request.urlopen(base + "/debug/cluster", timeout=5).read()
+        )
+        assert view["handoff_enabled"] is False
+    finally:
+        srv.stop()
+
+
+# -- degraded-mode routing matrix -------------------------------------
+
+
+def _request(descs, domain="basic"):
+    req = rls_pb2.RateLimitRequest(domain=domain)
+    for entries in descs:
+        d = req.descriptors.add()
+        for k, v in entries:
+            e = d.entries.add()
+            e.key, e.value = k, v
+    return req
+
+
+def _over_response(n, unit=rls_pb2.RateLimitResponse.RateLimit.MINUTE):
+    resp = rls_pb2.RateLimitResponse(
+        overall_code=rls_pb2.RateLimitResponse.OVER_LIMIT
+    )
+    for _ in range(n):
+        s = resp.statuses.add()
+        s.code = rls_pb2.RateLimitResponse.OVER_LIMIT
+        s.current_limit.requests_per_unit = 5
+        s.current_limit.unit = unit
+    return resp
+
+
+def _ok_response(n):
+    resp = rls_pb2.RateLimitResponse(
+        overall_code=rls_pb2.RateLimitResponse.OK
+    )
+    for _ in range(n):
+        resp.statuses.add().code = rls_pb2.RateLimitResponse.OK
+    return resp
+
+
+class _SwitchableReplica:
+    """Healthy replica that answers OVER for one hot descriptor value
+    and OK otherwise; flips to dead on demand."""
+
+    def __init__(self, hot_value):
+        self.hot_value = hot_value
+        self.dead = False
+
+    def __call__(self, req, timeout_s=None):
+        if self.dead:
+            raise FaultStatusError("UNAVAILABLE", "killed")
+        resp = rls_pb2.RateLimitResponse()
+        over_any = False
+        for d in req.descriptors:
+            if any(e.value == self.hot_value for e in d.entries):
+                s = resp.statuses.add()
+                s.code = rls_pb2.RateLimitResponse.OVER_LIMIT
+                s.current_limit.requests_per_unit = 5
+                s.current_limit.unit = (
+                    rls_pb2.RateLimitResponse.RateLimit.MINUTE
+                )
+                over_any = True
+            else:
+                resp.statuses.add().code = rls_pb2.RateLimitResponse.OK
+        resp.overall_code = (
+            rls_pb2.RateLimitResponse.OVER_LIMIT
+            if over_any
+            else rls_pb2.RateLimitResponse.OK
+        )
+        return resp
+
+
+@pytest.mark.parametrize(
+    "mode,hot_code,cold_code",
+    [
+        ("allow", rls_pb2.RateLimitResponse.OK, rls_pb2.RateLimitResponse.OK),
+        (
+            "deny",
+            rls_pb2.RateLimitResponse.OVER_LIMIT,
+            rls_pb2.RateLimitResponse.OVER_LIMIT,
+        ),
+        (
+            "local-cache",
+            rls_pb2.RateLimitResponse.OVER_LIMIT,
+            rls_pb2.RateLimitResponse.OK,
+        ),
+    ],
+)
+def test_failure_mode_matrix(mode, hot_code, cold_code):
+    """Owner down -> allow admits everything, deny denies everything,
+    local-cache denies exactly the keys recently seen over limit."""
+    replica = _SwitchableReplica("hot")
+    r = ReplicaRouter(
+        ["a"], [replica], eject_after=1, readmit_after_s=60.0,
+        failure_policy=mode,
+    )
+    try:
+        # Healthy pass: hot descriptor goes over limit (feeds the
+        # local-cache mode's over-limit cache), cold stays OK.
+        resp = r.should_rate_limit(
+            _request([[("key1", "hot")], [("key1", "cold")]])
+        )
+        OVER = rls_pb2.RateLimitResponse.OVER_LIMIT
+        assert [s.code for s in resp.statuses] == [
+            OVER,
+            rls_pb2.RateLimitResponse.OK,
+        ]
+        replica.dead = True
+        resp = r.should_rate_limit(
+            _request([[("key1", "hot")], [("key1", "cold")]])
+        )
+        assert [s.code for s in resp.statuses] == [hot_code, cold_code]
+        st = r.stats()
+        assert st["fallback_descriptors"] == 2
+        assert st["failure_mode"] == mode
+        if mode == "local-cache":
+            assert st["degraded_denials"] == 1
+        # Subsequent calls hit the ejected-circuit fast path; the
+        # matrix answer is stable.
+        resp = r.should_rate_limit(_request([[("key1", "hot")]]))
+        assert resp.statuses[0].code == hot_code
+    finally:
+        r.close()
+
+
+def test_failure_mode_aliases_and_validation():
+    ok = lambda req, timeout_s=None: _ok_response(len(req.descriptors))  # noqa: E731
+    r = ReplicaRouter(["a"], [ok], failure_policy="open")
+    assert r.failure_policy == "allow"
+    r.close()
+    r = ReplicaRouter(["a"], [ok], failure_policy="closed")
+    assert r.failure_policy == "deny"
+    r.close()
+    with pytest.raises(ValueError):
+        ReplicaRouter(["a"], [ok], failure_policy="bogus")
+
+
+def test_local_cache_entries_expire():
+    from ratelimit_tpu.cluster.router import OverLimitCache
+
+    t = [0.0]
+    c = OverLimitCache(capacity=2, clock=lambda: t[0])
+    c.put("a_", 60.0)
+    assert c.hit("a_")
+    t[0] = 61.0
+    assert not c.hit("a_")
+    # Capacity eviction: soonest-to-expire leaves first.
+    c.put("x_", 10.0)
+    c.put("y_", 99.0)
+    c.put("z_", 50.0)
+    assert len(c) == 2
+    assert not c.hit("x_")
+    assert c.hit("y_")
+
+
+# -- retry with backoff vs the caller's deadline ----------------------
+
+
+class _FlakyOnce:
+    def __init__(self, n_failures=1):
+        self.n_failures = n_failures
+        self.calls = 0
+
+    def __call__(self, req, timeout_s=None):
+        self.calls += 1
+        if self.calls <= self.n_failures:
+            raise FaultStatusError("UNAVAILABLE", "transient blip")
+        return _ok_response(len(req.descriptors))
+
+
+def test_transient_failure_retried_with_backoff():
+    import random as _random
+
+    sleeps = []
+    flaky = _FlakyOnce(1)
+    r = ReplicaRouter(
+        ["a"], [flaky], eject_after=5, retry_max=2, retry_base_s=0.05,
+        rng=_random.Random(7), sleep=sleeps.append,
+    )
+    try:
+        resp = r.should_rate_limit(_request([[("key1", "v")]]))
+        assert resp.statuses[0].code == rls_pb2.RateLimitResponse.OK
+        assert flaky.calls == 2
+        st = r.stats()
+        assert st["retries"] == 1
+        assert st["failovers"] == 0  # same-owner retry, not a re-own
+        assert st["ejections"] == 0
+        assert len(sleeps) == 1
+        # Exponential-backoff-with-jitter envelope: base x [0.5, 1.5).
+        assert 0.025 <= sleeps[0] < 0.075
+    finally:
+        r.close()
+
+
+def test_retry_never_sleeps_past_caller_deadline():
+    """Satellite regression: with a caller budget smaller than the
+    backoff, the router must NOT sleep-and-retry — the failure goes
+    straight to failover/fallback inside the budget."""
+    sleeps = []
+    always_down = _FlakyOnce(10**6)
+    r = ReplicaRouter(
+        ["a"], [always_down], eject_after=0, retry_max=5,
+        retry_base_s=10.0, sleep=sleeps.append, failure_policy="allow",
+    )
+    try:
+        resp = r.should_rate_limit(
+            _request([[("key1", "v")]]), timeout_s=0.25
+        )
+        # Budget could not cover a 10s backoff: zero sleeps, exactly
+        # one primary attempt (the single-replica failover set is
+        # empty), and the failure policy answered within the deadline.
+        assert sleeps == []
+        assert always_down.calls == 1
+        assert resp.statuses[0].code == rls_pb2.RateLimitResponse.OK
+        assert r.stats()["retries"] == 0
+    finally:
+        r.close()
+
+
+def test_retry_stops_when_circuit_opens():
+    sleeps = []
+    always_down = _FlakyOnce(10**6)
+    r = ReplicaRouter(
+        ["a"], [always_down], eject_after=1, retry_max=5,
+        retry_base_s=0.001, sleep=sleeps.append,
+    )
+    try:
+        r.should_rate_limit(_request([[("key1", "v")]]))
+        # First failure opens the circuit (eject_after=1): no retry
+        # hammering an ejected replica.
+        assert always_down.calls == 1
+        assert sleeps == []
+    finally:
+        r.close()
+
+
+# -- forwarding window ------------------------------------------------
+
+
+def test_forwarding_window_routes_moved_keys_to_old_owner():
+    """During handoff, a key whose owner changed keeps hitting its OLD
+    owner; end_forwarding makes the new owner authoritative."""
+    calls = {"a": 0, "b": 0}
+
+    def replica(name):
+        def call(req, timeout_s=None):
+            calls[name] += len(req.descriptors)
+            return _ok_response(len(req.descriptors))
+
+        return call
+
+    r = ReplicaRouter(["a", "b"], [replica("a"), replica("b")])
+    try:
+        # Find a descriptor owned by b under [a,b] (i.e. it MOVED away
+        # from a when b joined).
+        moved = None
+        for i in range(100):
+            d = [("key1", f"v{i}")]
+            stem = routing_key("basic", _request([d]).descriptors[0])
+            if (
+                owner_id(stem, ["a", "b"]) == "b"
+                and owner_id(stem, ["a"]) == "a"
+            ):
+                moved = d
+                break
+        assert moved is not None
+        r.begin_forwarding(["a"])
+        assert r.stats()["forwarding_active"]
+        r.should_rate_limit(_request([moved]))
+        assert calls == {"a": 1, "b": 0}  # forwarded to the old owner
+        assert r.stats()["forwarded"] == 1
+        r.end_forwarding()
+        r.should_rate_limit(_request([moved]))
+        assert calls == {"a": 1, "b": 1}  # new owner authoritative
+    finally:
+        r.close()
+
+
+def test_forwarding_skips_departed_or_dead_old_owner():
+    """Forwarding only applies when the old owner survives in the new
+    set with a closed circuit; otherwise the new owner serves."""
+    calls = {"b": 0}
+
+    def b_replica(req, timeout_s=None):
+        calls["b"] += len(req.descriptors)
+        return _ok_response(len(req.descriptors))
+
+    r = ReplicaRouter(["b"], [b_replica])
+    try:
+        r.begin_forwarding(["a"])  # a left the membership entirely
+        resp = r.should_rate_limit(_request([[("key1", "v")]]))
+        assert resp.statuses[0].code == rls_pb2.RateLimitResponse.OK
+        assert calls["b"] == 1
+        assert r.stats()["forwarded"] == 0
+    finally:
+        r.close()
+
+
+# -- router edge cases (satellite) ------------------------------------
+
+
+def test_single_replica_cluster_owns_everything():
+    owner_calls = []
+
+    def only(req, timeout_s=None):
+        owner_calls.append(len(req.descriptors))
+        return _ok_response(len(req.descriptors))
+
+    r = ReplicaRouter(["solo"], [only])
+    try:
+        resp = r.should_rate_limit(
+            _request([[("a", "1")], [("b", "2")], [("c", "3")]])
+        )
+        assert len(resp.statuses) == 3
+        assert owner_calls == [3]  # one sub-call, everything local
+        assert r.stats()["live_replicas"] == 1
+    finally:
+        r.close()
+
+
+def test_duplicate_replica_ids_rejected():
+    ok = lambda req, timeout_s=None: _ok_response(len(req.descriptors))  # noqa: E731
+    with pytest.raises(ValueError, match="unique"):
+        ReplicaRouter(["a", "a"], [ok, ok])
+
+
+# -- fault injector ---------------------------------------------------
+
+
+def test_fault_injector_modes():
+    inj = FaultInjector(sleep=lambda s: None)
+    log = []
+
+    def inner(req, timeout_s=None):
+        log.append(timeout_s)
+        return "resp"
+
+    t = inj.wrap("r1", inner)
+    assert t("req") == "resp"
+    inj.kill("r1")
+    with pytest.raises(FaultStatusError) as ei:
+        t("req")
+    assert ei.value.code().name == "UNAVAILABLE"
+    inj.heal("r1")
+    assert t("req") == "resp"
+    # Hang blocks (here: fake sleep) then raises DEADLINE_EXCEEDED,
+    # bounded by the caller's timeout.
+    waits = []
+    inj2 = FaultInjector(sleep=waits.append)
+    t2 = inj2.wrap("r1", inner)
+    inj2.hang("r1", 3600.0)
+    with pytest.raises(FaultStatusError) as ei:
+        t2("req", timeout_s=7.0)
+    assert ei.value.code().name == "DEADLINE_EXCEEDED"
+    assert waits == [7.0]
+    # Delay passes through after sleeping.
+    inj2.delay("r1", 0.5)
+    assert t2("req") == "resp"
+    assert waits[-1] == 0.5
+    # Partition = kill for a set.
+    inj2.partition("r1", "r2")
+    assert inj2.mode_of("r2") == "kill"
+
+
+def test_fault_injection_drives_ejection_and_recovery():
+    """The harness end-to-end at the router: kill -> eject -> heal ->
+    half-open probe readmits."""
+    inj = FaultInjector()
+    healthy = lambda req, timeout_s=None: _ok_response(len(req.descriptors))  # noqa: E731
+    r = ReplicaRouter(
+        ["a", "b"],
+        [inj.wrap("a", healthy), inj.wrap("b", healthy)],
+        eject_after=2,
+        readmit_after_s=0.05,
+    )
+    try:
+        inj.kill("a")
+        for i in range(12):
+            r.should_rate_limit(_request([[("key1", f"v{i}")]]))
+        st = r.stats()
+        assert st["ejections"] == 1
+        assert st["live_replicas"] == 1
+        assert {s["id"]: s["state"] for s in st["replica_states"]}[
+            "b"
+        ] == "closed"
+        inj.heal("a")
+        deadline = threading.Event()
+        for i in range(200):
+            r.should_rate_limit(_request([[("key1", f"w{i}")]]))
+            if r.stats()["readmissions"] == 1:
+                break
+            deadline.wait(0.01)
+        assert r.stats()["readmissions"] == 1
+        assert r.stats()["live_replicas"] == 2
+    finally:
+        r.close()

@@ -560,3 +560,56 @@ def test_debug_listener_defaults_to_loopback():
     assert args.debug_host == "127.0.0.1"
     help_text = p.format_help()
     assert "UNAUTHENTICATED" in help_text
+
+
+def test_watcher_keeps_membership_on_unparseable_entry(tmp_path):
+    """Satellite regression: a replicas file with one garbled token
+    raises in read_replicas_file, and the watcher's keep-old-on-error
+    rule keeps the CURRENT membership and retries — parity with
+    config reload's whole-file-or-nothing discipline."""
+    import time as _t
+
+    from ratelimit_tpu.cluster.proxy import (
+        RouterHolder,
+        read_replicas_file,
+        watch_replicas_file,
+    )
+    from ratelimit_tpu.cluster.router import ReplicaRouter
+
+    def fake(req, timeout_s=None):
+        return rls_pb2.RateLimitResponse()
+
+    f = tmp_path / "replicas.txt"
+    f.write_text("a:1\n")
+    bad = tmp_path / "bad.txt"
+    bad.write_text("a:1\nnot-an-address\n")
+    with pytest.raises(ValueError, match="unparseable"):
+        read_replicas_file(str(bad))
+
+    holder = RouterHolder(ReplicaRouter(["a:1"], [fake]))
+
+    def build(addrs):
+        return ReplicaRouter(addrs, [fake] * len(addrs))
+
+    t, stop = watch_replicas_file(holder, str(f), poll_s=0.05, build=build)
+    try:
+        import os
+
+        # Garbled write (a truncated port, a stray word): membership
+        # must NOT change and must NOT be marked consumed.
+        f.write_text("a:1\nb:\ngarbage\n")
+        os.utime(str(f), (1_000_000, 1_000_000))
+        _t.sleep(0.25)
+        assert holder.replica_ids == ["a:1"]
+        # The corrected file (same mtime — the bad read must not have
+        # consumed it) is picked up on a later poll.
+        f.write_text("a:1\nb:2\n")
+        os.utime(str(f), (1_000_000, 1_000_000))
+        deadline = _t.monotonic() + 5
+        while holder.replica_ids != ["a:1", "b:2"] and _t.monotonic() < deadline:
+            _t.sleep(0.05)
+        assert holder.replica_ids == ["a:1", "b:2"]
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        holder.close()

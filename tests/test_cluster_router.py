@@ -75,8 +75,21 @@ def test_rendezvous_membership_change_moves_about_one_nth():
 
 
 def test_routing_key_matches_cache_key_granularity():
+    # The routing identity IS the cache-key stem (cluster/hashing.py):
+    # byte-identical to limiter.cache_key.build_stem with no prefix,
+    # so a replica can evaluate ownership over its stored keys during
+    # counter handoff by stripping the window suffix.
+    from ratelimit_tpu.cluster.hashing import stem_of_cache_key
+    from ratelimit_tpu.limiter.cache_key import build_stem
+
     r = _request("dom", [[("a", "1"), ("b", "2")]])
-    assert routing_key("dom", r.descriptors[0]) == "dom|a_1|b_2"
+    key = routing_key("dom", r.descriptors[0])
+    assert key == "dom_a_1_b_2_"
+    assert key == build_stem("", "dom", r.descriptors[0].entries)
+    # A stored cache key (stem + window start, optionally prefixed)
+    # round-trips back to the same routing identity.
+    assert stem_of_cache_key(key + "1700000040") == key
+    assert stem_of_cache_key("pfx:" + key + "1700000040", "pfx:") == key
 
 
 # -- merge semantics with fake transports ------------------------------
