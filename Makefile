@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: all test lint sanitize bench bench-host replay-smoke cluster-smoke protos native serve check_config smoke_client metrics-smoke docker_image e2e e2e-local ci clean
+.PHONY: all test lint sanitize bench bench-host replay-smoke cluster-smoke chaos-smoke protos native serve check_config smoke_client metrics-smoke docker_image e2e e2e-local ci clean
 
 # C++ hot-path library: slot table + decide kernel (auto-built on
 # first import too; this forces it).  Goes through the Python builder
@@ -64,6 +64,16 @@ replay-smoke:
 cluster-smoke:
 	$(CPU_ENV) PALLAS_AXON_POOL_IPS= $(PY) scripts/cluster_smoke.py
 
+# Device-path chaos smoke: hang a bank's kernel launches under
+# sustained replay load and assert the fault-domain envelope — bounded
+# p99 (quarantine within one KERNEL_DEADLINE_S, no dispatch-timeout
+# stall), fallback admissions per DEVICE_FAILURE_MODE, and a
+# supervised warm restart that restores counters exactly (no window
+# restart); the uncontrolled leg shows the stall this PR retires.
+# Writes benchmarks/results/device_faults.json (docs/RESILIENCE.md).
+chaos-smoke:
+	$(CPU_ENV) PALLAS_AXON_POOL_IPS= $(PY) scripts/chaos_smoke.py
+
 # Regenerate committed protobuf classes after editing protos/.
 protos:
 	sh scripts/gen_protos.sh
@@ -110,7 +120,7 @@ e2e-local:
 # The full CI recipe (.github/workflows/ci.yaml runs exactly this):
 # native build, tests, offline config validation, black-box e2e,
 # bench smoke on the CPU platform.
-ci: lint native test sanitize check_config metrics-smoke bench-host replay-smoke cluster-smoke e2e-local
+ci: lint native test sanitize check_config metrics-smoke bench-host replay-smoke cluster-smoke chaos-smoke e2e-local
 	$(CPU_ENV) PALLAS_AXON_POOL_IPS= $(PY) bench.py
 
 clean:

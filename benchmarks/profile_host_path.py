@@ -750,7 +750,136 @@ def profile_overload():
     return results
 
 
+def profile_watchdog():
+    """Healthy-path cost of the device fault domain
+    (backends/fault_domain.py), against the acceptance budget —
+    <= 0.5us/request with the watchdog ENABLED and every bank closed,
+    and decisions identical enabled vs disabled.
+
+    Legs:
+
+    - ``ops``:    the exact extra per-item work _execute does when the
+                  domain is armed and healthy — the quarantine check,
+                  the swap-safe engine resolve, and the kernel-deadline
+                  timeout clamp — measured as a closure against an
+                  empty-loop baseline (the dispatcher's ms-scale batch
+                  window would drown the ns-scale delta in an
+                  end-to-end A/B);
+    - ``parity``: the same request stream through two REAL batched
+                  caches (dispatcher + device step), fault domain
+                  armed vs absent — every decision field must match.
+    """
+    from ratelimit_tpu.api import Descriptor, RateLimitRequest  # noqa: E402
+    from ratelimit_tpu.backends.tpu_cache import TpuRateLimitCache  # noqa: E402
+    from ratelimit_tpu.config.loader import ConfigFile, load_config  # noqa: E402
+    from ratelimit_tpu.stats.manager import Manager  # noqa: E402
+    from ratelimit_tpu.utils.time import PinnedTimeSource  # noqa: E402
+
+    yaml = (
+        "domain: domain\n"
+        "descriptors:\n"
+        "  - key: key\n"
+        "    rate_limit:\n"
+        "      unit: hour\n"
+        "      requests_per_unit: 1000\n"
+    )
+
+    def build(armed):
+        clock = PinnedTimeSource(1_700_000_000)
+        cache = TpuRateLimitCache(
+            CounterEngine(num_slots=1 << 12, buckets=(8, 64)),
+            clock,
+            batch_window_us=100,
+            kernel_deadline_s=0.25 if armed else 0.0,
+            fault_interval_s=0 if armed else None,  # no thread: ops only
+            fault_snapshot_interval_s=1e9,
+        )
+        mgr = Manager()
+        config = load_config([ConfigFile("config.bench", yaml)], mgr)
+        return cache, config
+
+    results = {}
+
+    # Leg 1 — the armed-path ops, per item (one bank item per request
+    # in the common case).
+    cache_on, config_on = build(armed=True)
+    fd = cache_on.fault_domain
+    n = 200_000
+    dispatch_timeout = 120.0
+
+    def armed_ops():
+        is_q = fd.is_quarantined
+        eng_at = fd.engine_at
+        kd = fd.kernel_deadline_s
+        sink = None
+        for _ in range(n):
+            if not is_q(0):
+                sink = eng_at(0)
+            timeout = dispatch_timeout
+            if kd < timeout:
+                timeout = kd
+        return sink, timeout
+
+    def baseline_ops():
+        sink = None
+        for _ in range(n):
+            sink = None
+            timeout = dispatch_timeout
+        return sink, timeout
+
+    armed_ops()
+    baseline_ops()
+    t_on = min(timed(armed_ops, reps=7)[0] for _ in range(3))
+    t_off = min(timed(baseline_ops, reps=7)[0] for _ in range(3))
+    results["armed_ops_us_per_item"] = (t_on - t_off) / n * 1e6
+    results["budget_us_per_req"] = 0.5
+    results["within_budget"] = results["armed_ops_us_per_item"] <= 0.5
+
+    # Leg 2 — decision parity through the real dispatcher path.
+    cache_off, config_off = build(armed=False)
+    rng = np.random.default_rng(11)
+    identical = True
+    for i in range(400):
+        req = RateLimitRequest(
+            "domain",
+            [Descriptor.of(("key", f"v{rng.integers(0, 32)}"))],
+            1,
+        )
+        st_on, _l1, _u1 = cache_on.do_limit_resolved(req, config_on)
+        st_off, _l2, _u2 = cache_off.do_limit_resolved(req, config_off)
+        a = [
+            (s.code, s.limit_remaining, s.duration_until_reset)
+            for s in st_on
+        ]
+        b = [
+            (s.code, s.limit_remaining, s.duration_until_reset)
+            for s in st_off
+        ]
+        if a != b:
+            identical = False
+            break
+    results["decisions_identical_armed_vs_off"] = identical
+    results["quarantined_banks_after"] = fd.quarantined_count()
+    cache_on.close()
+    cache_off.close()
+
+    path = os.path.join(
+        os.path.dirname(__file__), "results", "watchdog_overhead.json"
+    )
+    with open(path, "w") as f:
+        json.dump(results, f, indent=2)
+    print(json.dumps(results, indent=2))
+    print(f"wrote {path}")
+    if not identical or not results["within_budget"]:
+        print("FAIL: watchdog overhead/parity budget violated")
+        sys.exit(1)
+    return results
+
+
 def main():
+    if "--watchdog" in sys.argv:
+        profile_watchdog()
+        sys.exit(0)
     if "--overload" in sys.argv:
         profile_overload()
         sys.exit(0)

@@ -431,9 +431,88 @@ def _lock_dominated(index: ProjectIndex) -> Set[FunctionInfo]:
     return dominated
 
 
+#: Function names that ARE the request path: the service entry points
+#: and the backend decision seams every transport funnels through.
+#: Anything reachable from one of these (under-approximate call graph,
+#: no escape edges) runs with an RPC waiting on it.
+REQUEST_PATH_ROOTS = frozenset(
+    {
+        "should_rate_limit",
+        "_should_rate_limit_worker",
+        "do_limit",
+        "do_limit_resolved",
+    }
+)
+
+
+class BoundedWaitRule(ProjectRule):
+    """Untimed waits on the request path: every ``Event.wait()`` /
+    ``Condition.wait()`` / ``Thread.join()`` reachable from a request-
+    path root must carry a timeout.
+
+    The static twin of the runtime sanitizer's held-across-blocking
+    check, motivated by the device-path fault domain
+    (docs/RESILIENCE.md): the whole point of KERNEL_DEADLINE_S is that
+    no RPC ever blocks unboundedly on the device — an untimed wait
+    anywhere on the path re-opens that hole.  Background threads
+    (dispatcher collector, samplers) may block at their idle points;
+    only request-path reachability is a finding.  Intentional untimed
+    waits off the serving path carry a justified
+    ``# tpu-lint: disable=bounded-wait -- why``.
+    """
+
+    id = "bounded-wait"
+    description = (
+        "untimed Event.wait()/Condition.wait()/Thread.join() reachable "
+        "from the request path"
+    )
+
+    def check_project(self, index: ProjectIndex) -> List[Finding]:
+        roots = [
+            fn
+            for fn in index.functions.values()
+            if fn.name in REQUEST_PATH_ROOTS
+        ]
+        reach: dict = {}  # FunctionInfo -> one root qualname (evidence)
+        for root in roots:
+            for fn in index.reachable(root, escapes=False):
+                reach.setdefault(fn, root.qualname)
+        findings: List[Finding] = []
+        seen = set()
+        for fn, via in reach.items():
+            for bs in fn.blocking_sites:
+                desc = bs.desc
+                if not desc.startswith("untimed"):
+                    continue
+                if not (desc.endswith(".wait()") or desc.endswith(".join()")):
+                    continue
+                path, line = _site(fn, bs.node)
+                key = (path, line)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(
+                    Finding(
+                        rule_id=self.id,
+                        path=path,
+                        line=line,
+                        col=getattr(bs.node, "col_offset", 0),
+                        message=(
+                            f"{desc} in {fn.qualname} is reachable from "
+                            f"the request path (via {via}): an RPC can "
+                            "block on it forever — pass a timeout "
+                            "(KERNEL_DEADLINE_S-bounded) or move the "
+                            "wait off the serving path"
+                        ),
+                    )
+                )
+        return findings
+
+
 def make_concurrency_rules() -> List[ProjectRule]:
     return [
         LockOrderCycleRule(),
         BlockingUnderLockRule(),
         SharedStateRule(),
+        BoundedWaitRule(),
     ]

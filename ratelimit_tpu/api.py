@@ -77,11 +77,21 @@ class Descriptor:
 
 @dataclass(slots=True)
 class RateLimitRequest:
-    """RateLimitRequest: (domain, descriptors, hits_addend)."""
+    """RateLimitRequest: (domain, descriptors, hits_addend).
+
+    ``deadline`` is process-internal (never serialized): the caller's
+    remaining RPC deadline as an ABSOLUTE ``time.monotonic()`` instant,
+    stamped by the transport (server/grpc_server.py from
+    ``context.time_remaining()``).  The backend's dispatch wait is
+    bounded by it — ``min(KERNEL_DEADLINE_S, remaining)`` — and a wait
+    cut short answers per DEVICE_FAILURE_MODE instead of blocking past
+    the caller's deadline (backends/tpu_cache.py ``_execute``).  None
+    means the caller set no deadline."""
 
     domain: str
     descriptors: Sequence[Descriptor]
     hits_addend: int = 0
+    deadline: Optional[float] = None
 
 
 @dataclass(frozen=True, slots=True)

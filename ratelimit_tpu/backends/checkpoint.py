@@ -288,15 +288,45 @@ class CheckpointManager:
         """Snapshot all banks now.  Only the state COPY runs under
         engine exclusivity (dispatcher thread / inline lock); the
         expensive compression + disk write happen on this thread so
-        serving stalls only for the memcpy, not the I/O."""
+        serving stalls only for the memcpy, not the I/O.
+
+        Quarantined banks (backends/fault_domain.py) have no live
+        dispatcher to snapshot through; their HOST MIRROR — the state
+        actually serving — is snapshotted instead, so a process
+        restart during a quarantine episode still restores the
+        mirror's counters.  Banks with no mirror (DEVICE_FAILURE_MODE
+        allow/deny) keep their previous on-disk snapshot.  One broken
+        bank must never starve the others of snapshots."""
         roles = self._bank_roles()
+        fd = getattr(self.cache, "fault_domain", None)
         for idx, engine in enumerate(self.cache.engines()):
+            if fd is not None and fd.is_quarantined(idx):
+                snap = fd.mirror_snapshot(idx)
+                if snap is None:
+                    continue  # no mirror: the last snapshot stands
+                state, entries = snap
+                write_snapshot(
+                    self._bank_path(idx),
+                    engine.model.num_slots,
+                    state,
+                    entries,
+                    roles[idx],
+                    getattr(engine, "algorithm", "fixed_window"),
+                )
+                continue
             grabbed = {}
 
             def grab(e=engine, out=grabbed):
                 out["state"], out["entries"] = snapshot_engine(e)
 
-            self.cache.run_exclusive(engine, grab)
+            try:
+                self.cache.run_exclusive(engine, grab)
+            except Exception:
+                # The bank faulted between the quarantine check and
+                # the snapshot token (dead dispatcher): skip it this
+                # round; the fault domain's mirror covers the next.
+                logger.exception("bank %d snapshot skipped", idx)
+                continue
             write_snapshot(
                 self._bank_path(idx),
                 engine.model.num_slots,

@@ -30,6 +30,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..utils.time import REAL_MONOTONIC
 from .engine import HostDecisions
 
 
@@ -364,6 +365,7 @@ class BatchDispatcher:
         unhealthy_after: int = 3,
         on_state=None,
         eager_idle: bool = True,
+        stamp_clock=None,
     ):
         """`on_state(healthy: bool, reason: str)` is the backend-health
         seam (the Redis pool active-connection health analog,
@@ -414,6 +416,23 @@ class BatchDispatcher:
         self._consecutive_failures = 0
         self._reported_unhealthy = False
         self._dead: Optional[BaseException] = None
+        # Watchdog liveness stamps (backends/fault_domain.py): the
+        # collector marks when a device LAUNCH begins, the completer
+        # when a readback WAIT begins; each clears its own stamp when
+        # the call returns.  Single-writer plain attributes read
+        # lock-free by the watchdog thread — a stamp older than
+        # KERNEL_DEADLINE_S means the device call is stuck (hung
+        # kernel, dead tunnel) and the bank should be quarantined.
+        # `stamp_clock` is the injectable MonotonicClock seam so
+        # hang-detection tests run on synthetic time.
+        self._stamp_now = (stamp_clock or REAL_MONOTONIC).now
+        self._launch_busy_since: Optional[float] = None
+        self._complete_busy_since: Optional[float] = None
+        # Successful device-step completions: the watchdog arms the
+        # kernel deadline only after the first one, so first-batch XLA
+        # compilation (seconds to tens of seconds on big meshes) never
+        # reads as a hang.
+        self.completed_launches = 0
         # Intake is a plain list + condition variable, drained by the
         # collector in ONE swap per wakeup: queue.Queue pays a lock
         # acquisition per get (~0.8 ms per 1024-item batch on the
@@ -505,13 +524,33 @@ class BatchDispatcher:
         if token.error is not None:
             raise token.error
 
-    def stop(self) -> None:
+    def stuck_age(self, now: float) -> float:
+        """Seconds the oldest in-progress device call (launch or
+        readback wait) has been running, 0.0 when idle.  Lock-free
+        reads of the single-writer stamps; `now` must come from the
+        same clock as `stamp_clock`."""
+        age = 0.0
+        for since in (self._launch_busy_since, self._complete_busy_since):
+            if since is not None and now - since > age:
+                age = now - since
+        return age
+
+    def kill(self, exc: BaseException) -> None:
+        """Abandon this dispatcher WITHOUT joining its threads: mark
+        dead, fail everything queued/in-flight fast, report unhealthy.
+        The quarantine path uses this — a hung collector/completer
+        cannot be joined (the stuck jax call never returns), but its
+        waiters must be released and new submits must fast-fail so the
+        fault domain's fallback answers them instead."""
+        self._die(exc)
+
+    def stop(self, timeout: float = 10.0) -> None:
         with self._buf_cv:
             # No dead gate: stop must always reach the collector.
             self._buf.append(_STOP)
             self._buf_cv.notify()
-        self._thread.join(timeout=10)
-        self._completer.join(timeout=10)
+        self._thread.join(timeout=timeout)
+        self._completer.join(timeout=timeout)
 
     # -- internals -------------------------------------------------------
 
@@ -599,7 +638,11 @@ class BatchDispatcher:
             )
         if self.batch_items_hist is not None:
             self.batch_items_hist.observe(len(batch))
-        token = submit_items(self.engine, batch)
+        self._launch_busy_since = self._stamp_now()
+        try:
+            token = submit_items(self.engine, batch)
+        finally:
+            self._launch_busy_since = None
         if token is _SUBMIT_FAILED:
             self._note_step(False)
         elif token is not None:
@@ -749,7 +792,13 @@ class BatchDispatcher:
                 if kind == "token":
                     payload.event.set()
                 else:
-                    ok = complete_items(self.engine, payload, token)
+                    self._complete_busy_since = self._stamp_now()
+                    try:
+                        ok = complete_items(self.engine, payload, token)
+                    finally:
+                        self._complete_busy_since = None
+                    if ok:
+                        self.completed_launches += 1
                     with self._state_lock:
                         self._inflight -= 1
                     self._note_step(ok)

@@ -299,6 +299,56 @@ def _decide_host(
     )
 
 
+def decide_generic(
+    model,
+    fetched: np.ndarray,
+    hits_u32: np.ndarray,
+    limits_u32: np.ndarray,
+    shadow: np.ndarray,
+    dedup: _Dedup,
+    now: int,
+) -> HostDecisions:
+    """Host half of the generic algorithm protocol: the model rebuilds
+    per-lane effective (before, after) counts from its device readback,
+    then the SHARED threshold state machine (limiter.base.decide_batch)
+    produces codes/stat deltas — near-limit and partial-hit attribution
+    are identical across every algorithm by construction.  Generic
+    algorithms never feed the host over-limit cache (their capacity
+    refills continuously, so an OVER_LIMIT verdict is not valid for the
+    remainder of any window) — set_local_cache stays False.
+
+    Module-level (not a CounterEngine method) because the host mirror
+    engine (backends/host_engine.py) runs the same reconstruction on
+    its numpy replay of the kernel — the fallback path's decisions must
+    come from the same arithmetic as the device path's."""
+    from ..limiter.base import decide_batch
+
+    befores, afters = model.lane_counts(
+        fetched, dedup, hits_u32, limits_u32, now
+    )
+    count = len(hits_u32)
+    d = decide_batch(
+        limits=limits_u32,
+        befores=befores,
+        afters=afters,
+        hits=hits_u32.astype(np.int64),
+        near_ratio=model.near_ratio,
+        shadow_mask=shadow,
+        local_cache_mask=np.zeros(count, dtype=bool),
+    )
+    return HostDecisions(
+        codes=d.codes,
+        limit_remaining=d.limit_remaining,
+        befores=befores,
+        afters=afters,
+        over_limit=d.over_limit,
+        near_limit=d.near_limit,
+        within_limit=d.within_limit,
+        shadow_mode=d.shadow_mode,
+        set_local_cache=np.zeros(count, dtype=bool),
+    )
+
+
 class CounterEngine:
     def __init__(
         self,
@@ -599,40 +649,8 @@ class CounterEngine:
         dedup: _Dedup,
         now: int,
     ) -> HostDecisions:
-        """Host half of the generic algorithm protocol: the model
-        rebuilds per-lane effective (before, after) counts from its
-        device readback, then the SHARED threshold state machine
-        (limiter.base.decide_batch) produces codes/stat deltas —
-        near-limit and partial-hit attribution are identical across
-        every algorithm by construction.  Generic algorithms never
-        feed the host over-limit cache (their capacity refills
-        continuously, so an OVER_LIMIT verdict is not valid for the
-        remainder of any window) — set_local_cache stays False."""
-        from ..limiter.base import decide_batch
-
-        befores, afters = self.model.lane_counts(
-            fetched, dedup, hits_u32, limits_u32, now
-        )
-        count = len(hits_u32)
-        d = decide_batch(
-            limits=limits_u32,
-            befores=befores,
-            afters=afters,
-            hits=hits_u32.astype(np.int64),
-            near_ratio=self.model.near_ratio,
-            shadow_mask=shadow,
-            local_cache_mask=np.zeros(count, dtype=bool),
-        )
-        return HostDecisions(
-            codes=d.codes,
-            limit_remaining=d.limit_remaining,
-            befores=befores,
-            afters=afters,
-            over_limit=d.over_limit,
-            near_limit=d.near_limit,
-            within_limit=d.within_limit,
-            shadow_mode=d.shadow_mode,
-            set_local_cache=np.zeros(count, dtype=bool),
+        return decide_generic(
+            self.model, fetched, hits_u32, limits_u32, shadow, dedup, now
         )
 
     def _device_submit(self, dedup: _Dedup, now: int = 0):
@@ -753,8 +771,8 @@ class CounterEngine:
         counts = self.model.init_state()
         if self._device is not None:
             counts = jax.device_put(counts, self._device)
-        self._counts = counts
-        self.slot_table = self._table_cls(self.model.num_slots)
+        self._counts = counts  # tpu-lint: disable=shared-state -- reset() is a test/exclusive-access hook; serving never races it
+        self.slot_table = self._table_cls(self.model.num_slots)  # tpu-lint: disable=shared-state -- same exclusive-access contract
 
     # -- checkpoint surface (backends/checkpoint.py) --------------------
 

@@ -68,6 +68,46 @@ _CAT_LOCAL = 2  # host cache says over-limit: short-circuit
 _CAT_SKIP = 3  # shadow rule + cached over-limit: skip counter, OK
 
 
+def warmup_engine(engine) -> None:
+    """Pre-compile one engine's (bucket, readback-dtype) kernel shapes
+    with inert batches — DISTINCT IN-TABLE slots with hits=0 and
+    fresh=False, which scatter-add zero (or set a counter to its own
+    value on the unique path), so counter state and the slot table are
+    untouched.  In-table slots matter for the sharded engine: its
+    routed path drops out-of-table lanes before bank routing, so
+    out-of-table probes would collapse every bucket to the smallest
+    routed shape and serving would still pay compiles.
+
+    Module-level so the fault-domain supervisor can warm a freshly
+    rebuilt engine OFF the serving path before probing/re-admitting it
+    (a cold engine's first post-swap batch would otherwise pay XLA
+    compilation against the armed kernel deadline and read as a second
+    hang)."""
+    from .engine import HostBatch
+
+    for bucket in engine.buckets:
+        # One probe per readback dtype (u8 / u16 / u32 caps).
+        # Distinct in-table slots so the engine's dedup pass keeps all
+        # `bucket` lanes; the engine supplies the slots that compile
+        # its WORST-case routed width for this bucket (the sharded
+        # engine's all-one-bank skew probe — see
+        # ShardedCounterEngine.warmup_probe_slots).
+        probe_slots = engine.warmup_probe_slots(bucket)
+        # Companion arrays sized from the probe, not the bucket: the
+        # sharded engine clamps probe width to slots_per_bank on small
+        # tables.
+        width = len(probe_slots)
+        for probe_limit in (100, 60_000, 3_000_000_000):
+            batch = HostBatch(
+                slots=probe_slots,
+                hits=np.zeros(width, np.uint32),
+                limits=np.full(width, probe_limit, np.uint32),
+                fresh=np.zeros(width, bool),
+                shadow=np.zeros(width, bool),
+            )
+            engine.step(batch)
+
+
 class TpuRateLimitCache:
     def __init__(
         self,
@@ -86,6 +126,15 @@ class TpuRateLimitCache:
         resolution_cache_entries: int = 1 << 16,
         hotkeys_top_k: int = 0,
         algorithm_banks: Optional[dict] = None,
+        kernel_deadline_s: float = 0.0,
+        device_failure_mode: str = "host",
+        fault_clock=None,
+        fault_restart_backoff_s: float = 2.0,
+        fault_snapshot_interval_s: float = 30.0,
+        fault_interval_s: Optional[float] = None,
+        fault_probe_timeout_s: Optional[float] = None,
+        fault_restart_warmup: bool = True,
+        engine_factory=None,
     ):
         """`engine` may be a LIST of engines: N independent host LANES,
         each with its own slot table, dispatcher thread pair, and
@@ -225,40 +274,73 @@ class TpuRateLimitCache:
         for eng in self.algorithm_banks.values():
             self._inline_locks[id(eng)] = threading.Lock()
 
+        # Dispatcher construction knobs, kept for warm restarts: the
+        # fault-domain supervisor rebuilds a quarantined bank's
+        # dispatcher with exactly the serving parameters
+        # (_make_dispatcher).
+        self._batch_window_us = int(batch_window_us)
+        self._batch_limit = int(batch_limit)
+        self._pipeline_depth = pipeline_depth
+        self._unhealthy_after = unhealthy_after
+        self._stamp_clock = fault_clock
         self._dispatchers: dict = {}
         if batch_window_us > 0:
             for idx, lane in enumerate(self.lanes):
-                self._dispatchers[id(lane)] = BatchDispatcher(
+                self._dispatchers[id(lane)] = self._make_dispatcher(
                     lane,
-                    batch_window_us,
-                    batch_limit,
                     name=(
                         "tpu-dispatcher"
                         if len(self.lanes) == 1
                         else f"tpu-dispatcher-lane{idx}"
                     ),
-                    pipeline_depth=pipeline_depth,
-                    unhealthy_after=unhealthy_after,
                 )
             if per_second_engine is not None:
-                self._dispatchers[id(per_second_engine)] = BatchDispatcher(
-                    per_second_engine,
-                    batch_window_us,
-                    batch_limit,
-                    name="tpu-dispatcher-persecond",
-                    pipeline_depth=pipeline_depth,
-                    unhealthy_after=unhealthy_after,
+                self._dispatchers[id(per_second_engine)] = (
+                    self._make_dispatcher(
+                        per_second_engine, name="tpu-dispatcher-persecond"
+                    )
                 )
             for name in self._algo_order:
                 eng = self.algorithm_banks[name]
-                self._dispatchers[id(eng)] = BatchDispatcher(
-                    eng,
-                    batch_window_us,
-                    batch_limit,
-                    name="tpu-dispatcher-" + name,
-                    pipeline_depth=pipeline_depth,
-                    unhealthy_after=unhealthy_after,
+                self._dispatchers[id(eng)] = self._make_dispatcher(
+                    eng, name="tpu-dispatcher-" + name
                 )
+
+        # Device-path fault domain (backends/fault_domain.py): the
+        # watchdog/quarantine/warm-restart envelope around the banks.
+        # KERNEL_DEADLINE_S=0 (the library default) builds none — the
+        # serving path is then byte-identical to a build without the
+        # layer; the runner turns it on by default.  The failure mode
+        # is validated (and kept) even without a domain: the
+        # caller-deadline path answers with it.
+        from .fault_domain import FAILURE_MODES
+
+        if device_failure_mode not in FAILURE_MODES:
+            raise ValueError(
+                f"DEVICE_FAILURE_MODE must be one of "
+                f"{sorted(FAILURE_MODES)}, got {device_failure_mode!r}"
+            )
+        self.device_failure_mode = device_failure_mode
+        self.stat_deadline_answers = 0
+        self._health = None
+        self._health_hook = None
+        self.fault_domain = None
+        if kernel_deadline_s > 0 and self._dispatchers:
+            from .fault_domain import DeviceFaultDomain
+
+            self.fault_domain = DeviceFaultDomain(
+                self,
+                kernel_deadline_s,
+                failure_mode=device_failure_mode,
+                clock=fault_clock,
+                restart_backoff_s=fault_restart_backoff_s,
+                snapshot_interval_s=fault_snapshot_interval_s,
+                interval_s=fault_interval_s,
+                engine_factory=engine_factory,
+                probe_timeout_s=fault_probe_timeout_s,
+                restart_warmup=fault_restart_warmup,
+            )
+            self.fault_domain.start()
 
     # -- RateLimitCache seam --------------------------------------------
 
@@ -693,6 +775,52 @@ class TpuRateLimitCache:
             bank[1].append(b)
             bank[2].append(scratch.tobytes())
 
+    def _make_dispatcher(self, engine, name: str) -> BatchDispatcher:
+        """One dispatcher with THE serving parameters — construction
+        and warm-restart (fault_domain._try_restart) must agree."""
+        return BatchDispatcher(
+            engine,
+            self._batch_window_us,
+            self._batch_limit,
+            name=name,
+            pipeline_depth=self._pipeline_depth,
+            unhealthy_after=self._unhealthy_after,
+            stamp_clock=self._stamp_clock,
+        )
+
+    def _swap_bank(self, bank: int, new_engine, new_dispatcher) -> None:
+        """Install a warm-restarted engine + dispatcher at `bank`
+        (called by the fault-domain supervisor with the bank's
+        fallback lock held).  Bank indices and labels are stable; the
+        batch-shape histograms and the health binding carry over to
+        the new dispatcher; the old (dead) dispatcher leaves the
+        routing dict so stale submissions fast-fail."""
+        old = self.engines()[bank]
+        n_lanes = len(self.lanes)
+        if bank < n_lanes:
+            self.lanes[bank] = new_engine
+            if bank == 0:
+                self.engine = new_engine
+        elif self.per_second_engine is not None and bank == n_lanes:
+            self.per_second_engine = new_engine
+        else:
+            base = n_lanes + (1 if self.per_second_engine is not None else 0)
+            name = self._algo_order[bank - base]
+            self.algorithm_banks[name] = new_engine
+        old_d = self._dispatchers.pop(id(old), None)
+        self._inline_locks[id(new_engine)] = threading.Lock()
+        if old_d is not None:
+            new_dispatcher.batch_lanes_hist = old_d.batch_lanes_hist
+            new_dispatcher.batch_items_hist = old_d.batch_items_hist
+        self._dispatchers[id(new_engine)] = new_dispatcher
+        if self._health_hook is not None:
+            states, states_lock, make_on_state = self._health_hook
+            with states_lock:
+                if old_d is not None:
+                    states.pop(id(old_d), None)
+                states[id(new_dispatcher)] = True
+            new_dispatcher.on_state = make_on_state(id(new_dispatcher))
+
     def do_limit(
         self,
         request: RateLimitRequest,
@@ -704,6 +832,7 @@ class TpuRateLimitCache:
         return self._execute(
             limits, items, statuses, categories, hits_addend, now,
             len(request.descriptors),
+            deadline=request.deadline,
         )
 
     def do_limit_resolved(self, request: RateLimitRequest, config):
@@ -729,6 +858,7 @@ class TpuRateLimitCache:
         statuses = self._execute(
             limits, items, statuses, categories, hits_addend, now,
             len(request.descriptors),
+            deadline=request.deadline,
         )
         if hot is not None:
             self._note_hotkey_outcomes(hot, statuses, limits, hits_addend)
@@ -801,9 +931,21 @@ class TpuRateLimitCache:
         hits_addend: int,
         now: int,
         n: int,
+        deadline: Optional[float] = None,
     ) -> List[DescriptorStatus]:
-        """The device half: submit every bank's WorkItem, wait, then
-        fill the non-engine categories."""
+        """The device half: submit every bank's WorkItem, wait —
+        bounded by KERNEL_DEADLINE_S and the caller's remaining RPC
+        deadline (`deadline`, absolute time.monotonic seconds) — then
+        fill the non-engine categories.
+
+        Quarantined banks never reach the device: their items answer
+        from the DEVICE_FAILURE_MODE fallback (fault_domain
+        .run_fallback).  A wait that trips the kernel deadline records
+        a hang fault (quarantining the bank) and answers the same way;
+        a wait cut short by the CALLER's deadline answers per the
+        failure mode WITHOUT faulting the bank.  With no fault domain
+        (kernel_deadline_s=0) device errors raise CacheError exactly
+        as before."""
         n_lanes = len(self.lanes)
         # When this request's trace is recording, stamp each item's
         # dispatcher passage (submit here; launch/complete on the
@@ -811,7 +953,13 @@ class TpuRateLimitCache:
         # the stamps to spans after wait() — see _record_item_spans.
         span = TRACER.current()
         labels = self._bank_labels
-        items: List[tuple] = []  # (engine, WorkItem)
+        fd = self.fault_domain
+        pending: List[tuple] = []  # (bank, engine, item) awaiting wait
+        done: List[WorkItem] = []  # answered items (events recyclable)
+        inline: List[tuple] = []
+        # Submit all banks first, then wait: the banks' device steps
+        # overlap (the reference likewise pipelines both Redis clients
+        # before the first PipeDo, fixed_cache_impl.go:77-95).
         for bank, engine, item in prep_items:
             if span is not None:
                 item.trace = {
@@ -820,20 +968,21 @@ class TpuRateLimitCache:
                     ),
                     "submit": time.perf_counter(),
                 }
-            items.append((engine, item))
-
-        # Submit all banks first, then wait: the two banks' device
-        # steps overlap (the reference likewise pipelines both Redis
-        # clients before the first PipeDo, fixed_cache_impl.go:77-95).
-        inline: List[tuple] = []
-        for engine, item in items:
+            if fd is not None:
+                if fd.is_quarantined(bank):
+                    fd.run_fallback(bank, item)
+                    self._note_fallback()
+                    done.append(item)
+                    continue
+                engine = fd.engine_at(bank)  # swap-safe resolve
             d = self._dispatchers.get(id(engine))
             if d is None:
-                inline.append((engine, item))
-            else:
-                try:
-                    d.submit(item)
-                except Exception as e:
+                inline.append((bank, engine, item))
+                continue
+            try:
+                d.submit(item)
+            except Exception as e:
+                if fd is None:
                     # Dead dispatcher: fail THIS rpc immediately (the
                     # reference's RedisError-on-dead-pool analog) —
                     # never burn the wait timeout.
@@ -842,31 +991,89 @@ class TpuRateLimitCache:
                     raise CacheError(
                         f"counter engine failure: {e}"
                     ) from e
-        for engine, item in inline:
+                from .fault_domain import classify_fault
+
+                fd.record_fault(bank, classify_fault(e), e)
+                clone = self._clone_item(item)
+                fd.run_fallback(bank, clone)
+                self._note_fallback()
+                done.append(clone)
+                continue
+            pending.append((bank, engine, item))
+        for bank, engine, item in inline:
             with self._inline_locks[id(engine)]:
                 run_items(engine, [item])
-        for _, item in items:
+            pending.append((bank, engine, item))
+        kd = fd.kernel_deadline_s if fd is not None else None
+        for bank, engine, item in pending:
+            timeout = self.dispatch_timeout_s
+            if kd is not None:
+                d = self._dispatchers.get(id(engine))
+                if d is not None and d.completed_launches > 0:
+                    # Compile grace: until a bank completes its first
+                    # launch, XLA compilation owns the clock and the
+                    # generous dispatch timeout applies; afterwards
+                    # every launch is bounded by the kernel deadline.
+                    timeout = min(timeout, kd)
+            caller_bound = False
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining < timeout:
+                    timeout = max(0.0, remaining)
+                    caller_bound = True
             try:
-                item.wait(self.dispatch_timeout_s)
-            except Exception as e:
+                item.wait(timeout)
+            except TimeoutError as e:
+                if caller_bound:
+                    # The CALLER's deadline expired first: answer per
+                    # DEVICE_FAILURE_MODE without faulting the bank —
+                    # it may be healthy, just slower than this RPC can
+                    # wait (mirrors the cluster retry discipline,
+                    # test_retry_never_sleeps_past_caller_deadline).
+                    done.append(self._answer_failure_mode(item))
+                    continue
+                if fd is not None:
+                    from .fault_domain import FAULT_HANG
+
+                    fd.record_fault(bank, FAULT_HANG, e)
+                    clone = self._clone_item(item)
+                    fd.run_fallback(bank, clone)
+                    self._note_fallback()
+                    done.append(clone)
+                    continue
                 from ..service import CacheError
 
                 raise CacheError(f"counter engine failure: {e}") from e
-        # All waits succeeded: the completers' set() calls happened-
-        # before here and nothing touches these events again, so they
-        # are safe to clear and recycle (see _event_pool).  Failed or
-        # timed-out items above leave the loop by raising and keep
-        # their events out of the pool.
+            except Exception as e:
+                if fd is not None:
+                    from .fault_domain import classify_fault
+
+                    fd.record_fault(bank, classify_fault(e), e)
+                    clone = self._clone_item(item)
+                    fd.run_fallback(bank, clone)
+                    self._note_fallback()
+                    done.append(clone)
+                    continue
+                from ..service import CacheError
+
+                raise CacheError(f"counter engine failure: {e}") from e
+            done.append(item)
+        # All answered items' events are settled: the completers' (or
+        # fallback path's) set() calls happened-before here and
+        # nothing touches these events again, so they are safe to
+        # clear and recycle (see _event_pool).  Timed-out originals
+        # were replaced by clones and keep their events out of the
+        # pool — a stuck completer may still signal them later.
         pool = self._event_pool
         if len(pool) < 1024:
-            for _, item in items:
+            for item in done:
                 item.event.clear()
                 # Plain-list append/EAFP-pop are each one GIL-atomic
                 # op (no check-then-act; see _pool_event); the 1024
                 # bound is advisory — an overshoot wastes an Event.
                 pool.append(item.event)  # tpu-lint: disable=shared-state -- GIL-atomic list ops; pop is EAFP in _pool_event
         if span is not None:
-            self._record_item_spans(span, items)
+            self._record_item_spans(span, [it for _, _, it in prep_items])
 
         # Non-engine categories.
         reset_cache: dict = {}
@@ -908,6 +1115,7 @@ class TpuRateLimitCache:
         import logging
 
         log = logging.getLogger("ratelimit.health")
+        self._health = health
 
         # Per-dispatcher health, aggregated: the service is SERVING only
         # while EVERY bank's dispatcher is healthy — one bank recovering
@@ -917,6 +1125,25 @@ class TpuRateLimitCache:
 
         def make_on_state(key: int):
             def on_state(healthy: bool, reason: str) -> None:
+                fd = self.fault_domain
+                if fd is not None:
+                    # The fault domain owns device-path failure: the
+                    # replica keeps SERVING through the failure-mode
+                    # fallback, so a dead/failing dispatcher reports
+                    # DEGRADED instead of NOT_SERVING (the watchdog
+                    # quarantines it; the supervisor restarts it).
+                    if healthy:
+                        log.info("tpu backend healthy again: %s", reason)
+                        if (
+                            fd.quarantined_count() == 0
+                            and hasattr(health, "set_degraded")
+                        ):
+                            health.set_degraded(False, reason)
+                    else:
+                        log.error("tpu backend degraded: %s", reason)
+                        if hasattr(health, "set_degraded"):
+                            health.set_degraded(True, reason)
+                    return
                 # health.ok()/fail() happen INSIDE the lock so state
                 # transitions from concurrent dispatcher threads land
                 # in order — a stale ok() may never overtake a newer
@@ -933,6 +1160,7 @@ class TpuRateLimitCache:
 
             return on_state
 
+        self._health_hook = (states, states_lock, make_on_state)
         for d in self._dispatchers.values():
             d.on_state = make_on_state(id(d))
 
@@ -947,14 +1175,25 @@ class TpuRateLimitCache:
 
     def flush(self) -> None:
         """Drain the dispatcher queues (deterministic test hook; the
-        reference's memcached Flush analog, cache_impl.go:176-178)."""
+        reference's memcached Flush analog, cache_impl.go:176-178;
+        the graceful-drain leg of runner.stop).  Dead (quarantined)
+        dispatchers are skipped — their queues were already
+        fast-failed into the fallback."""
         for d in list(self._dispatchers.values()):
+            if d.dead is not None:
+                continue
             d.flush()
 
     def close(self) -> None:
+        fd, self.fault_domain = self.fault_domain, None
+        if fd is not None:
+            fd.stop()
         dispatchers, self._dispatchers = list(self._dispatchers.values()), {}
         for d in dispatchers:
-            d.stop()
+            # A dead dispatcher may have a STUCK collector/completer
+            # (hang fault) that can never be joined; don't burn the
+            # full join timeout on it.
+            d.stop(timeout=0.5 if d.dead is not None else 10.0)
 
     # Batch-size histogram ladder: powers of two up to the default
     # batch limit (these histograms count lanes/items, not ms).
@@ -1004,47 +1243,68 @@ class TpuRateLimitCache:
             store.counter_fn(
                 scope + ".shadow." + name + ".diverge", lambda p=pair: p[1]
             )
+        # Fault-domain family + the caller-deadline answer counter
+        # (the latter exists even without a domain — the deadline path
+        # answers per DEVICE_FAILURE_MODE regardless).
+        store.counter_fn(
+            scope + ".fault.deadline_answers",
+            lambda: self.stat_deadline_answers,
+        )
+        if self.fault_domain is not None:
+            self.fault_domain.register_stats(store, scope + ".fault")
         for idx, engine in enumerate(self.engines()):
             base = f"{scope}.bank{idx}"
             # Cached snapshots updated by the table-owning thread —
             # never call into the (unsynchronized) native table from
-            # observer threads.
-            store.gauge_fn(base + ".live_keys", lambda e=engine: e.stat_live_keys)
+            # observer threads.  Closures resolve the engine BY INDEX
+            # per scrape (self._engine_at): a supervised warm restart
+            # replaces the engine object, and the gauges must follow.
+            store.gauge_fn(
+                base + ".live_keys",
+                lambda i=idx: self._engine_at(i).stat_live_keys,
+            )
             # Evictions are monotonic — a counter (paired with the
             # num_slots capacity gauge below, so "about to exhaust
             # TPU_NUM_SLOTS" is a dashboard alert, not a runtime
             # error surprise).  Window rollovers likewise count fresh
             # slot sightings (a new window's first batch appearance).
             store.counter_fn(
-                base + ".evictions", lambda e=engine: e.stat_evictions
+                base + ".evictions",
+                lambda i=idx: self._engine_at(i).stat_evictions,
             )
             store.counter_fn(
                 base + ".window_rollovers",
-                lambda e=engine: e.stat_window_rollovers,
+                lambda i=idx: self._engine_at(i).stat_window_rollovers,
             )
             store.gauge_fn(
-                base + ".num_slots", lambda e=engine: e.model.num_slots
+                base + ".num_slots",
+                lambda i=idx: self._engine_at(i).model.num_slots,
             )
             store.gauge_fn(
                 base + ".slot_fill_pct",
-                lambda e=engine: (
-                    100 * e.stat_live_keys // max(1, e.model.num_slots)
+                lambda i=idx: (
+                    100
+                    * self._engine_at(i).stat_live_keys
+                    // max(1, self._engine_at(i).model.num_slots)
                 ),
             )
             d = self._dispatchers.get(id(engine))
             if d is not None:
                 store.gauge_fn(
-                    base + ".dispatch_queue", lambda dd=d: dd.queue_depth()
+                    base + ".dispatch_queue",
+                    lambda i=idx: self._disp_stat(i, "queue_depth"),
                 )
                 store.gauge_fn(
                     base + ".dispatch_queue_hwm",
-                    lambda dd=d: dd.queue_depth_hwm(),
+                    lambda i=idx: self._disp_stat(i, "queue_depth_hwm"),
                 )
                 store.gauge_fn(
-                    base + ".inflight_launches", lambda dd=d: dd.inflight()
+                    base + ".inflight_launches",
+                    lambda i=idx: self._disp_stat(i, "inflight"),
                 )
                 store.gauge_fn(
-                    base + ".inflight_hwm", lambda dd=d: dd.inflight_hwm()
+                    base + ".inflight_hwm",
+                    lambda i=idx: self._disp_stat(i, "inflight_hwm"),
                 )
                 # Batch-shape histograms, observed once per launch on
                 # the collector thread (dispatcher._launch): lanes per
@@ -1057,6 +1317,18 @@ class TpuRateLimitCache:
                 d.batch_items_hist = store.histogram(
                     base + ".batch_items", self._BATCH_BOUNDS
                 )
+
+    def _engine_at(self, idx: int):
+        """Swap-safe engine accessor for scrape closures: a warm
+        restart replaces the engine OBJECT at a bank; index-based
+        reads follow the replacement."""
+        return self.engines()[idx]
+
+    def _disp_stat(self, idx: int, method: str) -> int:
+        """Swap-safe dispatcher gauge read; 0 while a bank is between
+        dispatchers (quarantined, mid-restart)."""
+        d = self._dispatchers.get(id(self.engines()[idx]))
+        return 0 if d is None else getattr(d, method)()
 
     def engines(self):
         """All live counter banks: lanes first in lane order, then the
@@ -1086,46 +1358,52 @@ class TpuRateLimitCache:
 
     def warmup(self) -> None:
         """Pre-compile every (bucket, readback-dtype) kernel shape so
-        the first real RPC never pays XLA compilation.  Uses inert
-        batches — DISTINCT IN-TABLE slots with hits=0 and fresh=False,
-        which scatter-add zero (or set a counter to its own value on
-        the unique path), so counter state and the slot table are
-        untouched.  In-table slots matter for the sharded engine: its
-        routed path drops out-of-table lanes before bank routing, so
-        out-of-table probes would collapse every bucket to the smallest
-        routed shape and serving would still pay compiles.  Call before
+        the first real RPC never pays XLA compilation.  Call before
         serving starts — it steps the engines directly."""
-        import numpy as np
-
         for engine in self.engines():
-            from .engine import HostBatch
-
-            for bucket in engine.buckets:
-                # One probe per readback dtype (u8 / u16 / u32 caps).
-                # Distinct in-table slots so the engine's dedup pass
-                # keeps all `bucket` lanes; the engine supplies the
-                # slots that compile its WORST-case routed width for
-                # this bucket (the sharded engine's all-one-bank skew
-                # probe — see ShardedCounterEngine.warmup_probe_slots).
-                probe_slots = engine.warmup_probe_slots(bucket)
-                # Companion arrays sized from the probe, not the
-                # bucket: the sharded engine clamps probe width to
-                # slots_per_bank on small tables.
-                width = len(probe_slots)
-                for probe_limit in (100, 60_000, 3_000_000_000):
-                    batch = HostBatch(
-                        slots=probe_slots,
-                        hits=np.zeros(width, np.uint32),
-                        limits=np.full(width, probe_limit, np.uint32),
-                        fresh=np.zeros(width, bool),
-                        shadow=np.zeros(width, bool),
-                    )
-                    engine.step(batch)
+            warmup_engine(engine)
 
     # -- internals -------------------------------------------------------
 
+    def _clone_item(self, item: WorkItem) -> WorkItem:
+        """A fallback twin of `item`: same pack and apply closure, but
+        a FRESH event — the original's may still be signalled later by
+        a stuck completer, and a recycled event that fires twice would
+        corrupt a later request."""
+        return WorkItem(
+            now=item.now,
+            lanes=(),
+            pack=item.get_pack(),
+            apply=item.apply,
+            defer_apply=True,
+        )
+
+    def _answer_failure_mode(self, item: WorkItem) -> WorkItem:
+        """Caller-deadline expiry on a HEALTHY (just slow) bank:
+        synthesize the DEVICE_FAILURE_MODE answer — deny answers
+        OVER_LIMIT, allow (and host, which has no mirror to consult
+        outside quarantine) answers OK — with zero stat deltas."""
+        from .host_engine import STATIC_ALLOW, STATIC_DENY
+
+        clone = self._clone_item(item)
+        eng = (
+            STATIC_DENY if self.device_failure_mode == "deny" else STATIC_ALLOW
+        )
+        run_items(eng, [clone])
+        clone.wait(5.0)
+        self.stat_deadline_answers += 1  # tpu-lint: disable=shared-state -- GIL-atomic stats counter, scrape-only reader
+        self._note_fallback()
+        return clone
+
+    def _note_fallback(self) -> None:
+        """Mark this thread's in-flight request as fallback-answered:
+        its flight-ring record stamps FLIGHT_CODE_FALLBACK."""
+        fl = self.flight
+        if fl is not None:
+            fl.note_fallback()
+
     @staticmethod
-    def _record_item_spans(span, items: List[tuple]) -> None:
+    def _record_item_spans(span, items: List[WorkItem]) -> None:
         """Turn each item's (submit, launch, complete) perf_counter
         stamps into two child spans — ``backend.dispatch`` (intake
         queue + collect + batch assembly, host-side) and
@@ -1134,8 +1412,10 @@ class TpuRateLimitCache:
         happens-before edge made the dispatcher threads' stamps
         visible.  Failed steps leave stamps missing; record what
         exists."""
-        for _, item in items:
+        for item in items:
             tr = item.trace
+            if tr is None:
+                continue
             launch = tr.get("launch")
             complete = tr.get("complete")
             attrs = {"bank": tr["bank"], "lanes": item.n_lanes}

@@ -132,3 +132,129 @@ class FaultInjector:
             return transport(request, timeout_s=timeout_s)
 
         return call
+
+
+# ---------------------------------------------------------------------------
+# device-seam injection (backends/fault_domain.py's proof harness)
+# ---------------------------------------------------------------------------
+
+
+class DeviceLostError(RuntimeError):
+    """An injected 'the device went away' failure; the message carries
+    the device-lost vocabulary so fault_domain.classify_fault buckets
+    it exactly like a real PJRT/XLA device loss."""
+
+    def __init__(self, label: str):
+        super().__init__(f"device lost: injected on bank {label}")
+
+
+class DeviceFaultInjector:
+    """Per-bank fault switchboard at the ENGINE seam — the dispatcher's
+    submit/launch boundary (engine.submit_packed) and the readback wait
+    (engine.step_complete).
+
+    The intra-replica mirror of :class:`FaultInjector`: from the
+    dispatcher's point of view a wedged XLA launch, a dead axon tunnel
+    and a crashed device all look like "the engine call hung or
+    raised" — injecting there exercises the exact watchdog-stamp /
+    wait-deadline / classification path real device faults take
+    (backends/fault_domain.py), deterministically and without
+    hardware.  Modes (per bank label; ``heal`` clears):
+
+      hang         -> the next engine call blocks until healed (a hung
+                      kernel launch / blackholed tunnel);
+      raise        -> every call raises RuntimeError (a bug or bad
+                      input in the step);
+      device_lost  -> every call raises :class:`DeviceLostError`.
+
+    ``at`` chooses the seam: "submit" (the collector's launch leg,
+    trips the launch stamp) or "complete" (the completer's readback
+    wait).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._mode: Dict[str, tuple] = {}  # label -> (mode, at)
+        # hang mode parks engine calls on this event so `heal` can
+        # release them (a plain sleep could not be interrupted and
+        # would leak the collector for the whole test run).
+        self._release = threading.Event()
+        self.stat_injected = 0
+
+    def hang(self, label: str, at: str = "submit") -> None:
+        with self._lock:
+            self._release.clear()
+            self._mode[label] = ("hang", at)
+
+    def raise_error(self, label: str, at: str = "submit") -> None:
+        with self._lock:
+            self._mode[label] = ("raise", at)
+
+    def device_lost(self, label: str, at: str = "submit") -> None:
+        with self._lock:
+            self._mode[label] = ("device_lost", at)
+
+    def heal(self, *labels: str) -> None:
+        """Clear faults (all when empty) and release hung calls."""
+        with self._lock:
+            if not labels:
+                self._mode.clear()
+            else:
+                for lb in labels:
+                    self._mode.pop(lb, None)
+            self._release.set()
+
+    def mode_of(self, label: str):
+        with self._lock:
+            m = self._mode.get(label)
+            return m[0] if m else None
+
+    def _maybe_inject(self, label: str, seam: str) -> None:
+        with self._lock:
+            m = self._mode.get(label)
+        if m is None:
+            return
+        mode, at = m
+        if at != seam:
+            return
+        self.stat_injected += 1  # tpu-lint: disable=shared-state -- GIL-atomic test-harness tally
+        if mode == "hang":
+            # Block until healed: the dispatcher thread is now stuck
+            # exactly like a wedged device call; the watchdog's stamp
+            # check must quarantine the bank around it.
+            self._release.wait()
+            raise DeviceLostError(label)
+        if mode == "device_lost":
+            raise DeviceLostError(label)
+        raise RuntimeError(f"injected device-step failure on bank {label}")
+
+    def wrap_engine(self, label: str, engine):
+        """Wrap one bank's engine; the proxy keeps the full engine
+        surface (checkpoint, handoff, stats) via delegation and
+        intercepts only the two dispatcher-facing calls."""
+        return _FaultyEngine(self, label, engine)
+
+
+class _FaultyEngine:
+    """Engine proxy injecting at the submit/complete seams; everything
+    else (model, slot_table, export/import, gc, stats) delegates."""
+
+    def __init__(self, injector: DeviceFaultInjector, label: str, engine):
+        self._injector = injector
+        self._label = label
+        self._engine = engine
+
+    def submit_packed(self, now, key_blob, meta):
+        self._injector._maybe_inject(self._label, "submit")
+        return self._engine.submit_packed(now, key_blob, meta)
+
+    def step_submit(self, batch, now=0):
+        self._injector._maybe_inject(self._label, "submit")
+        return self._engine.step_submit(batch, now)
+
+    def step_complete(self, token):
+        self._injector._maybe_inject(self._label, "complete")
+        return self._engine.step_complete(token)
+
+    def __getattr__(self, name):
+        return getattr(self._engine, name)

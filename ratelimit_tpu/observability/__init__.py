@@ -24,6 +24,7 @@ from .detectors import (
     QueueSaturationDetector,
 )
 from .flight import (
+    FLIGHT_CODE_FALLBACK,
     FLIGHT_CODE_SHED,
     FLIGHT_DTYPE,
     FlightRecorder,
@@ -52,6 +53,7 @@ __all__ = [
     "Detector",
     "ErrorRateDetector",
     "Ewma",
+    "FLIGHT_CODE_FALLBACK",
     "FLIGHT_CODE_SHED",
     "FLIGHT_DTYPE",
     "FinishedTrace",

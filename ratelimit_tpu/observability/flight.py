@@ -113,15 +113,28 @@ FLIGHT_CODE_SHED = 8
 FLIGHT_CODE_DEGRADED = 9
 FLIGHT_CODE_FORWARDED = 10
 
+#: Device-path fault-domain sentinel (backends/fault_domain.py): the
+#: request was answered by the DEVICE_FAILURE_MODE fallback — the
+#: quarantined bank's host mirror engine, a static allow/deny, or the
+#: caller-deadline answer — instead of the device.  The wire response
+#: stays within the protocol; the ring must separate "the device
+#: decided" from "the fault domain answered" so incident forensics and
+#: the chaos harness can count fallback admissions.  Same
+#: outside-the-protocol rationale as FLIGHT_CODE_SHED.
+FLIGHT_CODE_FALLBACK = 11
+
 
 class _Note(threading.local):
     """Per-thread (stem_hash, lane) deposit from the backend's request
     assembly, consumed by the same thread's ``record()`` call.
     ``shadow`` carries the candidate-algorithm (code2, algo_id) pair
-    deposited after a shadow comparison (backends/tpu_cache.py)."""
+    deposited after a shadow comparison (backends/tpu_cache.py);
+    ``fallback`` marks the request as answered by the device-path
+    fault domain's failure-mode fallback."""
 
     value: tuple = (0, -1)
     shadow: tuple = (-1, 0)
+    fallback: bool = False
 
 
 class FlightRecorder:
@@ -169,6 +182,13 @@ class FlightRecorder:
         divergence comparison); consumed by the next ``record()``."""
         self._note.shadow = (code2, algo_id)
 
+    def note_fallback(self) -> None:
+        """Mark this thread's in-flight request as answered by the
+        device-path failure-mode fallback (backends/fault_domain.py);
+        its ring record stamps FLIGHT_CODE_FALLBACK.  Consumed by the
+        next ``record()`` on this thread."""
+        self._note.fallback = True
+
     def _make_record(self):
         """Build ``record`` as a closure over locals: every per-call
         ``self.`` lookup and the clock indirection is paid once here
@@ -194,6 +214,9 @@ class FlightRecorder:
         no_note = (0, -1)
         no_shadow = (-1, 0)
 
+        fallback_code = FLIGHT_CODE_FALLBACK
+        shed_code = FLIGHT_CODE_SHED
+
         def record(
             domain: str, code: int, hits_addend: int, latency_ms: float
         ) -> None:
@@ -205,6 +228,13 @@ class FlightRecorder:
             code2, algo = note.shadow
             if code2 != -1:
                 note.shadow = no_shadow  # consume
+            if note.fallback:
+                note.fallback = False  # consume
+                # The fault domain answered this request; sheds keep
+                # their own code (a shed never reaches the backend, so
+                # the two can't genuinely collide).
+                if code != shed_code:
+                    code = fallback_code
             dom = domain_ids.get(domain)
             if dom is None:
                 dom = intern(domain)
@@ -306,6 +336,10 @@ class FlightRecorder:
                 d["degraded"] = True
             elif code == FLIGHT_CODE_FORWARDED:
                 d["forwarded"] = True
+            elif code == FLIGHT_CODE_FALLBACK:
+                # Device-path fault domain answered this one
+                # (backends/fault_domain.py).
+                d["fallback"] = True
             out.append(d)
         return out
 

@@ -176,6 +176,19 @@ def create_limiter(s: Settings, stats_manager: Manager, local_cache, time_source
             resolution_cache_entries=s.resolution_cache_entries,
             hotkeys_top_k=s.hotkeys_top_k,
             algorithm_banks=make_algorithm_banks(s),
+            # Device-path fault domain (backends/fault_domain.py;
+            # docs/RESILIENCE.md): on by default — a hung kernel
+            # launch quarantines its bank within KERNEL_DEADLINE_S
+            # instead of stalling RPCs for the dispatch timeout.
+            kernel_deadline_s=s.kernel_deadline_s,
+            device_failure_mode=s.device_failure_mode,
+            fault_restart_backoff_s=s.device_restart_backoff_s,
+            fault_snapshot_interval_s=s.tpu_checkpoint_interval_s,
+            fault_interval_s=(
+                s.device_watchdog_interval_s
+                if s.device_watchdog_interval_s > 0
+                else None
+            ),
         )
     raise ValueError(f"Invalid setting for BackendType: {s.backend_type}")
 
@@ -538,10 +551,33 @@ class Runner:
         self._stopped.wait()
 
     def stop(self) -> None:
-        """Graceful stop (reference Stop, runner.go:136-143 +
-        handleGracefulShutdown, server_impl.go:302-313)."""
+        """Graceful drain + stop (reference Stop, runner.go:136-143 +
+        handleGracefulShutdown, server_impl.go:302-313), in the
+        crash-only order (docs/RESILIENCE.md "Graceful drain"):
+
+        1. health flips NOT_SERVING (load balancers stop routing; the
+           signal handler in run() already did this for SIGTERM —
+           repeated here so direct stop() calls get the same order);
+        2. the gRPC listener stops accepting NEW RPCs but grants
+           in-flight ones a grace period to complete — their dispatch
+           waits still have a live backend (the cache closes LAST);
+        3. the dispatcher intake drains (flush) so every accepted
+           decision is committed to the counters;
+        4. the final checkpoint snapshots the fully-drained counters —
+           a restart restores every window intact;
+        5. only then do the remaining listeners and the backend stop.
+        """
+        if self.health is not None:
+            self.health.fail()
         if self.grpc_server is not None:
             self.grpc_server.stop(grace=5).wait(timeout=10)
+        if self.cache is not None and hasattr(self.cache, "flush"):
+            try:
+                self.cache.flush()
+            except Exception:
+                logger.exception("dispatcher drain failed during shutdown")
+        if self.checkpointer is not None:
+            self.checkpointer.stop(final_checkpoint=True)
         for srv in (self.http_server, self.debug_server):
             if srv is not None:
                 srv.stop()
@@ -549,8 +585,6 @@ class Runner:
             self.runtime.stop()
         if self.detectors is not None:
             self.detectors.stop()
-        if self.checkpointer is not None:
-            self.checkpointer.stop(final_checkpoint=True)
         if self.statsd is not None:
             self.statsd.stop()
         if self.cache is not None and hasattr(self.cache, "close"):

@@ -242,6 +242,10 @@ ENDPOINT_BLURBS = {
     "/debug/pprof/": "this index (Go pprof path alias)",
     "/debug/tracez": "slowest + most recent request traces",
     "/debug/hotkeys": "top-K hottest descriptor stems (JSON)",
+    "/debug/faults": (
+        "device-path fault domain: per-bank quarantine state, fault "
+        "counters, restart history (JSON)"
+    ),
     "/debug/incidents": "captured anomaly incident reports (JSON)",
     "/debug/slo": "per-domain SLI / error-budget burn summary (JSON)",
     "/debug/overload": (

@@ -117,6 +117,15 @@ def _ratelimit_handler(
             with root:
                 with TRACER.span("decode"):
                     request = request_from_pb(request_pb)
+                # Propagate the caller's gRPC deadline into the backend
+                # dispatch wait: the service answers per
+                # DEVICE_FAILURE_MODE instead of blocking past it
+                # (backends/tpu_cache.py _execute; api.RateLimitRequest
+                # .deadline).  time_remaining() is None when the client
+                # set no deadline.
+                remaining = context.time_remaining()
+                if remaining is not None:
+                    request.deadline = time.monotonic() + remaining
                 t_decoded = time.perf_counter()
                 try:
                     response = service.should_rate_limit(request)

@@ -14,11 +14,35 @@ class HealthChecker:
         self._cond = threading.Condition()
         self._healthy = True
         self._version = 0  # bumps on every state change (Watch wakeups)
+        # DEGRADED is orthogonal to healthy: the replica is still
+        # SERVING (load balancers keep routing to it) but part of its
+        # device path is quarantined and answering from the failure-
+        # mode fallback (backends/fault_domain.py).  Surfaces on
+        # /healthcheck ("OK (degraded: ...)") and /debug/faults; the
+        # grpc.health.v1 status stays SERVING.
+        self._degraded = False
+        self._degraded_reason = ""
 
     @property
     def healthy(self) -> bool:
         with self._cond:
             return self._healthy
+
+    @property
+    def degraded(self) -> bool:
+        with self._cond:
+            return self._degraded
+
+    @property
+    def degraded_reason(self) -> str:
+        with self._cond:
+            return self._degraded_reason
+
+    def set_degraded(self, degraded: bool, reason: str = "") -> None:
+        """Flip the degraded flag (fault-domain quarantine state)."""
+        with self._cond:
+            self._degraded = bool(degraded)
+            self._degraded_reason = reason if degraded else ""
 
     def fail(self) -> None:
         """Mark unhealthy (health.go:49-52)."""

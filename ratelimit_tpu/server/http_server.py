@@ -232,10 +232,16 @@ def add_json_handler(server: HttpServer, service, flight=None, slo=None) -> None
 
 def add_healthcheck(server: HttpServer, health: HealthChecker) -> None:
     def handle(h) -> None:
-        if health.healthy:
-            h._reply(200, b"OK")
-        else:
+        if not health.healthy:
             h._reply(500, b"NOT_HEALTHY")
+        elif getattr(health, "degraded", False):
+            # Still 200 — load balancers must keep routing here (the
+            # fault-domain fallback is answering) — but the body says
+            # part of the device path is quarantined.
+            reason = getattr(health, "degraded_reason", "")
+            h._reply(200, f"OK (degraded: {reason})".encode())
+        else:
+            h._reply(200, b"OK")
 
     server.add_route("GET", "/healthcheck", handle)
 
@@ -514,6 +520,27 @@ def add_debug_routes(
             200, json.dumps(res).encode(), content_type="application/json"
         )
 
+    def faults(h) -> None:
+        # Device-path fault-domain zPage (backends/fault_domain.py;
+        # docs/RESILIENCE.md): per-bank quarantine state, fault
+        # taxonomy counters, restart/probe history — "a bank is
+        # quarantined, now what?" starts here
+        # (docs/INCIDENT_RUNBOOK.md).
+        fd = getattr(getattr(service, "cache", None), "fault_domain", None)
+        if fd is None:
+            h._reply(
+                404,
+                b"device fault domain disabled (KERNEL_DEADLINE_S=0 "
+                b"or backend without one)\n",
+            )
+            return
+        h._reply(
+            200,
+            json.dumps(fd.summary()).encode(),
+            content_type="application/json",
+        )
+
+    server.add_route("GET", "/debug/faults", faults)
     server.add_route("GET", "/debug/incidents", incidents)
     server.add_route("GET", "/debug/slo", slo_summary)
     server.add_route("GET", "/debug/overload", overload_view)

@@ -202,6 +202,24 @@ class Settings:
     tpu_unhealthy_after: int = 3
     # Pre-compile every (bucket, dtype) kernel shape at startup.
     tpu_warmup: bool = False
+    # Device-path fault domain (backends/fault_domain.py;
+    # docs/RESILIENCE.md).  KERNEL_DEADLINE_S bounds every kernel
+    # launch once a bank has completed its first one (first-batch XLA
+    # compilation keeps the generous dispatch timeout): a launch stuck
+    # past it trips the watchdog, quarantines the bank, and re-routes
+    # its lanes per DEVICE_FAILURE_MODE — `host` (default) serves them
+    # from a numpy mirror that keeps counting, `allow`/`deny` answer
+    # statically.  0 disables the fault domain entirely (the pre-PR-10
+    # behavior: a hung launch stalls its RPCs for the dispatch
+    # timeout).  The supervisor retries a quarantined bank's warm
+    # restart every DEVICE_RESTART_BACKOFF_S (doubling, capped 60 s);
+    # periodic in-memory snapshots every TPU_CHECKPOINT_INTERVAL_S
+    # bound restart loss to one interval.
+    kernel_deadline_s: float = 0.25
+    device_failure_mode: str = "host"
+    device_restart_backoff_s: float = 2.0
+    # Watchdog cadence; 0 = auto (half the kernel deadline, capped 1s).
+    device_watchdog_interval_s: float = 0.0
     # Counter-state checkpointing (closes the restart-amnesia gap the
     # reference delegates to Redis durability; empty = disabled).
     tpu_checkpoint_dir: str = ""
@@ -406,6 +424,12 @@ def new_settings() -> Settings:
         tpu_pipeline_depth=_env_int("TPU_PIPELINE_DEPTH", 2),
         tpu_unhealthy_after=_env_int("TPU_UNHEALTHY_AFTER", 3),
         tpu_warmup=_env_bool("TPU_WARMUP", False),
+        kernel_deadline_s=_env_float("KERNEL_DEADLINE_S", 0.25),
+        device_failure_mode=_env_str("DEVICE_FAILURE_MODE", "host"),
+        device_restart_backoff_s=_env_float("DEVICE_RESTART_BACKOFF_S", 2.0),
+        device_watchdog_interval_s=_env_float(
+            "DEVICE_WATCHDOG_INTERVAL_S", 0.0
+        ),
         tpu_checkpoint_dir=_env_str("TPU_CHECKPOINT_DIR", ""),
         tpu_checkpoint_interval_s=_env_float("TPU_CHECKPOINT_INTERVAL_S", 30.0),
         tpu_compile_cache_dir=_env_str("TPU_COMPILE_CACHE_DIR", ""),

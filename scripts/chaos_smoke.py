@@ -1,0 +1,338 @@
+"""Device-path chaos smoke (make chaos-smoke): the fault-domain
+envelope proven under sustained load in a few seconds, wired into
+`make ci` (docs/RESILIENCE.md).
+
+Two legs over the SAME workload — background replay threads hammering
+a wide keyspace plus a fixed-limit probe key offered well past its
+budget — with a hang injected at the kernel-launch seam mid-run
+(cluster/faults.py DeviceFaultInjector):
+
+- CONTROLLED (KERNEL_DEADLINE_S armed, DEVICE_FAILURE_MODE=host):
+  asserts the hung bank is quarantined within ~one watchdog deadline,
+  request p99 stays bounded through the fault (no dispatch-timeout
+  stall), fallback admissions respect the failure mode (the host
+  mirror keeps enforcing the probe key's limit), fallback decisions
+  stamp FLIGHT_CODE_FALLBACK, and the supervised warm restart
+  restores counters so the probe key admits EXACTLY its limit across
+  the whole episode — no window restart.
+- UNCONTROLLED (fault domain off, the pre-PR-10 path, with the
+  dispatch timeout shrunk from its 120 s default to keep the smoke
+  fast): the same hang stalls every request on the bank for the full
+  dispatch timeout and then errors them — the envelope this PR
+  retires.
+
+Also runs an allow/deny matrix leg (static fallback answers) and
+writes benchmarks/results/device_faults.json with both legs +
+embedded checks, the membership_churn.json pattern.
+
+Run:  JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from ratelimit_tpu.api import Code, Descriptor, RateLimitRequest  # noqa: E402
+from ratelimit_tpu.backends.engine import CounterEngine  # noqa: E402
+from ratelimit_tpu.backends.tpu_cache import TpuRateLimitCache  # noqa: E402
+from ratelimit_tpu.cluster.faults import DeviceFaultInjector  # noqa: E402
+from ratelimit_tpu.config.loader import ConfigFile, load_config  # noqa: E402
+from ratelimit_tpu.observability import (  # noqa: E402
+    FLIGHT_CODE_FALLBACK,
+    make_flight_recorder,
+)
+from ratelimit_tpu.service import CacheError  # noqa: E402
+from ratelimit_tpu.stats.manager import Manager  # noqa: E402
+from ratelimit_tpu.utils.time import PinnedTimeSource  # noqa: E402
+
+YAML = """
+domain: chaos
+descriptors:
+  - key: probe
+    rate_limit:
+      unit: minute
+      requests_per_unit: 120
+  - key: load
+    rate_limit:
+      unit: minute
+      requests_per_unit: 1000000
+"""
+
+KERNEL_DEADLINE_S = 0.2
+UNCONTROLLED_DISPATCH_TIMEOUT_S = 2.0  # stands in for the 120 s default
+LOAD_THREADS = 4
+LOAD_KEYS = 64
+
+
+def check(checks, name, ok, detail):
+    checks.append({"name": name, "ok": bool(ok), "detail": detail})
+    print(f"  [{'ok' if ok else 'FAIL'}] {name}: {detail}")
+
+
+def build_cache(inj, controlled, mode="host"):
+    engine = inj.wrap_engine("lane0", CounterEngine(num_slots=4096, buckets=(8, 64)))
+    return TpuRateLimitCache(
+        engine,
+        time_source=PinnedTimeSource(1_000_000),
+        batch_window_us=200,
+        dispatch_timeout_s=(
+            120.0 if controlled else UNCONTROLLED_DISPATCH_TIMEOUT_S
+        ),
+        kernel_deadline_s=KERNEL_DEADLINE_S if controlled else 0.0,
+        device_failure_mode=mode,
+        fault_restart_backoff_s=0.25,
+        fault_snapshot_interval_s=1000.0,  # snapshot_now pins the envelope
+        fault_interval_s=0.05,
+        fault_probe_timeout_s=10.0,
+    )
+
+
+def run_leg(controlled):
+    """One leg: load + probe traffic, hang injected mid-run, heal,
+    then (controlled) wait for the warm restart.  Returns metrics."""
+    inj = DeviceFaultInjector()
+    cache = build_cache(inj, controlled)
+    flight = make_flight_recorder(4096)
+    cache.flight = flight
+    mgr = Manager()
+    cfg = load_config([ConfigFile("config.c", YAML)], mgr)
+    probe_rule = cfg.get_limit("chaos", Descriptor.of(("probe", "p")))
+    load_rule = cfg.get_limit("chaos", Descriptor.of(("load", "x")))
+
+    lat_ms = []
+    lat_lock = threading.Lock()
+    errors = [0]
+    stop = threading.Event()
+
+    def loader(tid):
+        i = 0
+        while not stop.is_set():
+            i += 1
+            key = f"x{(tid * 7919 + i) % LOAD_KEYS}"
+            req = RateLimitRequest(
+                "chaos", [Descriptor.of(("load", key))], 1
+            )
+            t0 = time.perf_counter()
+            try:
+                st = cache.do_limit(req, [load_rule])[0]
+                flight.record("chaos", int(st.code), 1,
+                              (time.perf_counter() - t0) * 1e3)
+            except CacheError:
+                errors[0] += 1
+            with lat_lock:
+                lat_ms.append((time.perf_counter() - t0) * 1e3)
+
+    def probe_once():
+        req = RateLimitRequest("chaos", [Descriptor.of(("probe", "p"))], 1)
+        t0 = time.perf_counter()
+        try:
+            st = cache.do_limit(req, [probe_rule])[0]
+            code = st.code
+            flight.record("chaos", int(code), 1,
+                          (time.perf_counter() - t0) * 1e3)
+        except CacheError:
+            errors[0] += 1
+            code = None
+        with lat_lock:
+            lat_ms.append((time.perf_counter() - t0) * 1e3)
+        return code
+
+    threads = [
+        threading.Thread(target=loader, args=(t,), daemon=True)
+        for t in range(LOAD_THREADS)
+    ]
+    for t in threads:
+        t.start()
+
+    admitted = 0
+    # Phase 1 — healthy: 60 probe offers.
+    for _ in range(60):
+        admitted += probe_once() is Code.OK
+    if controlled:
+        cache.fault_domain.snapshot_now()
+
+    # Phase 2 — hang the bank mid-load.  The uncontrolled leg's probes
+    # each burn the FULL dispatch timeout sequentially (that stall IS
+    # the finding), so it offers fewer of them to keep the smoke fast.
+    fault_probes = 60 if controlled else 6
+    inj.hang("lane0")
+    t_fault = time.monotonic()
+    quarantine_latency = None
+    fault_codes = []
+    for _ in range(fault_probes):
+        fault_codes.append(probe_once())
+        if (
+            controlled
+            and quarantine_latency is None
+            and cache.fault_domain.is_quarantined(0)
+        ):
+            quarantine_latency = time.monotonic() - t_fault
+    admitted += sum(c is Code.OK for c in fault_codes)
+
+    # Phase 3 — heal; controlled leg waits for the supervised restart.
+    inj.heal()
+    restarted = False
+    if controlled:
+        deadline = time.monotonic() + 30
+        while (
+            cache.fault_domain.is_quarantined(0)
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        restarted = not cache.fault_domain.is_quarantined(0)
+    # Phase 4 — post-fault probes (the rest of the 240 total offers).
+    post_errors_before = errors[0]
+    for _ in range(120):
+        admitted += probe_once() is Code.OK
+
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    with lat_lock:
+        lats = np.array(lat_ms)
+    fd = cache.fault_domain
+    fallback_records = sum(
+        1 for r in flight.snapshot_dicts() if r.get("fallback")
+    )
+    metrics = {
+        "leg": "controlled" if controlled else "uncontrolled",
+        "offers": 180 + fault_probes,
+        "probe_admitted": int(admitted),
+        "probe_limit": 120,
+        "requests": int(len(lats)),
+        "cache_errors": int(errors[0]),
+        "post_heal_errors": int(errors[0] - post_errors_before),
+        "p50_ms": round(float(np.percentile(lats, 50)), 3),
+        "p99_ms": round(float(np.percentile(lats, 99)), 3),
+        "max_ms": round(float(lats.max()), 3),
+        "quarantine_latency_s": (
+            round(quarantine_latency, 3)
+            if quarantine_latency is not None
+            else None
+        ),
+        "warm_restarted": restarted,
+        "flight_fallback_records": int(fallback_records),
+        "faults": dict(fd.stat_faults) if fd is not None else None,
+        "fallback_decisions": (
+            fd.stat_fallback_decisions if fd is not None else None
+        ),
+        "restarts": fd.stat_restarts if fd is not None else None,
+    }
+    cache.close()
+    return metrics
+
+
+def run_mode_matrix():
+    """allow|deny static fallback answers on a faulted bank."""
+    out = {}
+    for mode, want in (("allow", Code.OK), ("deny", Code.OVER_LIMIT)):
+        inj = DeviceFaultInjector()
+        cache = build_cache(inj, controlled=True, mode=mode)
+        mgr = Manager()
+        cfg = load_config([ConfigFile("config.c", YAML)], mgr)
+        rule = cfg.get_limit("chaos", Descriptor.of(("probe", "p")))
+        req = RateLimitRequest("chaos", [Descriptor.of(("probe", "p"))], 1)
+        cache.do_limit(req, [rule])
+        inj.raise_error("lane0")
+        codes = [cache.do_limit(req, [rule])[0].code for _ in range(5)]
+        out[mode] = {
+            "answers": [int(c) for c in codes],
+            "ok": all(c is want for c in codes),
+        }
+        inj.heal()
+        cache.close()
+    return out
+
+
+def main() -> int:
+    checks = []
+    print("== controlled leg (fault domain armed, mode=host) ==")
+    ctl = run_leg(controlled=True)
+    print(json.dumps(ctl, indent=2))
+    print("== uncontrolled leg (fault domain off) ==")
+    unc = run_leg(controlled=False)
+    print(json.dumps(unc, indent=2))
+    matrix = run_mode_matrix()
+
+    check(
+        checks,
+        "quarantined_within_one_deadline",
+        ctl["quarantine_latency_s"] is not None
+        and ctl["quarantine_latency_s"] <= 2 * KERNEL_DEADLINE_S + 0.25,
+        f"{ctl['quarantine_latency_s']}s vs deadline {KERNEL_DEADLINE_S}s",
+    )
+    check(
+        checks,
+        "controlled_p99_bounded",
+        ctl["p99_ms"] <= 1000.0 and ctl["cache_errors"] == 0,
+        f"p99 {ctl['p99_ms']}ms, errors {ctl['cache_errors']} "
+        "(no stall, no failed RPCs)",
+    )
+    check(
+        checks,
+        "controlled_probe_exact_limit",
+        ctl["probe_admitted"] == ctl["probe_limit"] and ctl["warm_restarted"],
+        f"admitted {ctl['probe_admitted']}/{ctl['probe_limit']} across "
+        f"snapshot->hang->fallback->restart (restarted={ctl['warm_restarted']})",
+    )
+    check(
+        checks,
+        "fallback_stamped_in_flight_ring",
+        ctl["flight_fallback_records"] > 0,
+        f"{ctl['flight_fallback_records']} FLIGHT_CODE_FALLBACK "
+        f"({FLIGHT_CODE_FALLBACK}) records",
+    )
+    check(
+        checks,
+        "uncontrolled_stalls_and_errors",
+        unc["max_ms"] >= UNCONTROLLED_DISPATCH_TIMEOUT_S * 1000 * 0.9
+        and unc["cache_errors"] > 0,
+        f"max {unc['max_ms']}ms (dispatch timeout "
+        f"{UNCONTROLLED_DISPATCH_TIMEOUT_S * 1000:.0f}ms), "
+        f"{unc['cache_errors']} failed RPCs — the retired envelope",
+    )
+    check(
+        checks,
+        "failure_mode_matrix",
+        matrix["allow"]["ok"] and matrix["deny"]["ok"],
+        f"allow -> {matrix['allow']['answers']}, "
+        f"deny -> {matrix['deny']['answers']}",
+    )
+
+    result = {
+        "kernel_deadline_s": KERNEL_DEADLINE_S,
+        "uncontrolled_dispatch_timeout_s": UNCONTROLLED_DISPATCH_TIMEOUT_S,
+        "controlled": ctl,
+        "uncontrolled": unc,
+        "failure_mode_matrix": matrix,
+        "checks": checks,
+    }
+    out = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks",
+        "results",
+        "device_faults.json",
+    )
+    for arg in sys.argv[1:]:
+        if arg.startswith("--out="):
+            out = arg.split("=", 1)[1]
+    with open(out, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(f"wrote {out}")
+    failed = [c for c in checks if not c["ok"]]
+    if failed:
+        print(f"CHAOS SMOKE FAILED: {[c['name'] for c in failed]}")
+        return 1
+    print("chaos smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

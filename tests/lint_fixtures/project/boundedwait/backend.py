@@ -1,0 +1,18 @@
+"""Fixture: untimed waits reached THROUGH a helper from the request
+path (bounded-wait true positives with a cross-module cause)."""
+import threading
+
+
+class Backend:
+    def __init__(self):
+        self._event = threading.Event()
+        self._worker = threading.Thread(target=self._loop)
+
+    def await_batch(self):
+        self._event.wait()  # finding: untimed, on the request path
+
+    def join_worker(self):
+        self._worker.join()  # finding: untimed, on the request path
+
+    def _loop(self):
+        pass

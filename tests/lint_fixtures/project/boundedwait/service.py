@@ -1,0 +1,11 @@
+from .backend import Backend
+
+
+class Service:
+    def __init__(self):
+        self.backend = Backend()
+
+    def do_limit(self, request, limits):
+        self.backend.await_batch()
+        self.backend.join_worker()
+        return []

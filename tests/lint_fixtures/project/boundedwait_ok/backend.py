@@ -1,0 +1,26 @@
+"""Fixture: bounded-wait true negatives — timed waits on the request
+path, untimed waits only on background threads, and a justified
+suppression."""
+import threading
+
+
+class Backend:
+    def __init__(self):
+        self._event = threading.Event()
+        self._cv = threading.Condition()
+        self._worker = threading.Thread(target=self._loop)
+
+    def await_batch(self):
+        self._event.wait(0.25)  # timed: bounded by the kernel deadline
+
+    def drain(self):
+        # Shutdown path, not the request path (nothing named do_limit/
+        # should_rate_limit reaches it).
+        self._worker.join()
+
+    def legacy_wait(self):
+        self._event.wait()  # tpu-lint: disable=bounded-wait -- fixture: justified legacy wait
+
+    def _loop(self):
+        with self._cv:
+            self._cv.wait()  # background thread: its idle block is fine

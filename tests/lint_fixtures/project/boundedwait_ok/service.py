@@ -1,0 +1,14 @@
+from .backend import Backend
+
+
+class Service:
+    def __init__(self):
+        self.backend = Backend()
+
+    def do_limit(self, request, limits):
+        self.backend.await_batch()
+        self.backend.legacy_wait()
+        return []
+
+    def shutdown(self):
+        self.backend.drain()

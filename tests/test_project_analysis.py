@@ -311,3 +311,24 @@ def test_full_tree_clean_and_fast():
     assert findings == [], [f.text() for f in findings]
     assert n_files > 60
     assert elapsed < 10.0, f"analyzer took {elapsed:.1f}s"
+
+
+# -- bounded-wait ------------------------------------------------------------
+
+
+def test_bounded_wait_request_path_true_positive():
+    findings = by_rule(project_findings("boundedwait"), "bounded-wait")
+    assert len(findings) == 2, [f.text() for f in findings]
+    messages = " | ".join(f.message for f in findings)
+    assert "untimed self._event.wait()" in messages
+    assert ".join()" in messages
+    assert "reachable from the request path" in messages
+    # Findings anchor in the module holding the wait, not the caller.
+    assert all(f.path.endswith("backend.py") for f in findings)
+
+
+def test_bounded_wait_true_negatives_and_suppression():
+    """Timed waits, background-thread idle blocks, off-path joins and
+    the justified suppression all stay clean."""
+    findings = by_rule(project_findings("boundedwait_ok"), "bounded-wait")
+    assert findings == [], [f.text() for f in findings]

@@ -231,7 +231,11 @@ def test_runner_wires_settings_reloader(runner):
 def test_backend_death_flips_health_and_fast_fails(tmp_path):
     """VERDICT r1 #5: kill the collector thread; /healthcheck must go
     500 and RPCs must error fast (no dispatch-timeout burn) — the
-    Redis active-connection health analog (driver_impl.go:31-52)."""
+    Redis active-connection health analog (driver_impl.go:31-52).
+    KERNEL_DEADLINE_S=0 pins the PRE-fault-domain envelope: with the
+    fault domain on (the runner default) a dead collector degrades
+    and serves via the fallback instead — see
+    test_backend_death_degrades_but_keeps_serving."""
     import time as _time
 
     root = tmp_path / "runtime"
@@ -244,6 +248,7 @@ def test_backend_death_flips_health_and_fast_fails(tmp_path):
         backend_type="tpu", tpu_num_slots=1 << 10,
         tpu_batch_window_us=200, tpu_batch_buckets=[8],
         tpu_dispatch_timeout_s=30.0,
+        kernel_deadline_s=0.0,  # fault domain OFF: legacy envelope
         runtime_path=str(root), runtime_subdirectory="ratelimit",
         local_cache_size_in_bytes=0, expiration_jitter_max_seconds=0,
     )
@@ -272,6 +277,71 @@ def test_backend_death_flips_health_and_fast_fails(tmp_path):
             _grpc_call(r, _request("basic", [("key1", "x")]))
         assert _time.monotonic() - t0 < 5.0  # fast, not the 30s timeout
         assert exc_info.value.code() == grpc.StatusCode.UNKNOWN
+    finally:
+        r.stop()
+
+
+def test_backend_death_degrades_but_keeps_serving(tmp_path):
+    """The PR 10 envelope (docs/RESILIENCE.md): with the fault domain
+    on (the runner default), a dead collector quarantines its bank —
+    /healthcheck stays 200 (degraded: the fallback is answering), the
+    RPC still gets a decision, and /debug/faults reports the
+    quarantine."""
+    import json as _json
+    import time as _time
+
+    root = tmp_path / "runtime"
+    config_dir = root / "ratelimit" / "config"
+    config_dir.mkdir(parents=True)
+    (config_dir / "basic.yaml").write_text(BASIC_YAML)
+    settings = Settings(
+        host="127.0.0.1", port=0, grpc_host="127.0.0.1", grpc_port=0,
+        debug_host="127.0.0.1", debug_port=0, use_statsd=False,
+        backend_type="tpu", tpu_num_slots=1 << 10,
+        tpu_batch_window_us=200, tpu_batch_buckets=[8],
+        tpu_dispatch_timeout_s=30.0,
+        kernel_deadline_s=0.25, device_failure_mode="host",
+        # No restart during the test window: the quarantined state is
+        # what's being asserted.
+        device_restart_backoff_s=60.0,
+        runtime_path=str(root), runtime_subdirectory="ratelimit",
+        local_cache_size_in_bytes=0, expiration_jitter_max_seconds=0,
+    )
+    r = Runner(settings)
+    r.start()
+    try:
+        assert _http(r, "/healthcheck")[0] == 200
+        resp = _grpc_call(r, _request("basic", [("key1", "x")]))
+        assert resp.overall_code == rls_pb2.RateLimitResponse.OK
+
+        d = next(iter(r.cache._dispatchers.values()))
+        with d._buf_cv:
+            d._buf.append(object())
+            d._buf_cv.notify()
+        deadline = _time.monotonic() + 5
+        while d.dead is None and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        assert d.dead is not None
+
+        # RPCs keep answering (host fallback), fast.
+        t0 = _time.monotonic()
+        resp = _grpc_call(r, _request("basic", [("key1", "x")]))
+        assert _time.monotonic() - t0 < 5.0
+        assert resp.overall_code == rls_pb2.RateLimitResponse.OK
+
+        # Health: serving but degraded.
+        status, body = _http(r, "/healthcheck")
+        assert status == 200
+        assert b"degraded" in body
+
+        # /debug/faults reports the quarantine.
+        status, body = _http(
+            r, "/debug/faults", port=r.debug_server.bound_port
+        )
+        assert status == 200
+        faults = _json.loads(body)
+        assert faults["quarantined_banks"] == 1
+        assert faults["banks"][0]["state"] == "quarantined"
     finally:
         r.stop()
 
