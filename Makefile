@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: all test lint sanitize bench bench-host replay-smoke cluster-smoke chaos-smoke protos native serve check_config smoke_client metrics-smoke docker_image e2e e2e-local ci clean
+.PHONY: all test lint sanitize native-asan sanitize-native bench bench-host replay-smoke cluster-smoke chaos-smoke protos native serve check_config smoke_client metrics-smoke docker_image e2e e2e-local ci clean
 
 # C++ hot-path library: slot table + decide kernel (auto-built on
 # first import too; this forces it).  Goes through the Python builder
@@ -36,6 +36,18 @@ lint:
 # (analysis/sanitizer.py, docs/STATIC_ANALYSIS.md).
 sanitize:
 	TPU_SANITIZE=1 $(PY) -m pytest tests/ -q
+
+# ASan+UBSan side-path build of the native library (never touches
+# the production .so or its content stamp).
+native-asan:
+	$(PY) scripts/sanitize_native.py --build-only
+
+# Native differential suites + the seeded 10k-batch fuzzer against the
+# instrumented library (scripts/sanitize_native.py; skips with a
+# one-line reason when the toolchain is absent — never fails ci for
+# a missing g++).
+sanitize-native:
+	$(PY) scripts/sanitize_native.py
 
 # Headline benchmark on the default JAX device (real chip under axon).
 bench:
@@ -120,7 +132,7 @@ e2e-local:
 # The full CI recipe (.github/workflows/ci.yaml runs exactly this):
 # native build, tests, offline config validation, black-box e2e,
 # bench smoke on the CPU platform.
-ci: lint native test sanitize check_config metrics-smoke bench-host replay-smoke cluster-smoke chaos-smoke e2e-local
+ci: lint native test sanitize sanitize-native check_config metrics-smoke bench-host replay-smoke cluster-smoke chaos-smoke e2e-local
 	$(CPU_ENV) PALLAS_AXON_POOL_IPS= $(PY) bench.py
 
 clean:

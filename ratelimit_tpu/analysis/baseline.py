@@ -29,10 +29,28 @@ from .engine import Finding
 #: The committed default baseline, next to this module.
 DEFAULT_BASELINE_PATH = Path(__file__).with_name("baseline.json")
 
+#: Repo root (the directory holding the ratelimit_tpu package): the
+#: anchor that makes baseline paths invocation-point independent.
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def _norm_path(path: str) -> str:
+    """Separator- and anchor-normalized path: absolute paths under
+    the repo root collapse to the repo-relative form the committed
+    baseline stores, so `--fail-on-new` matches no matter what cwd or
+    path spelling the analyzer was invoked with."""
+    s = path.replace("\\", "/")
+    p = Path(s)
+    if p.is_absolute():
+        try:
+            s = p.resolve().relative_to(_REPO_ROOT).as_posix()
+        except ValueError:
+            pass
+    return s
+
 
 def _key(rule: str, path: str, message: str) -> tuple:
-    # normalize path separators so a Windows checkout and CI agree
-    return (rule, path.replace("\\", "/"), message)
+    return (rule, _norm_path(path), message)
 
 
 def load_baseline(path: Optional[str] = None) -> dict:
@@ -81,7 +99,7 @@ def write_baseline(
         "findings": [
             {
                 "rule": f.rule_id,
-                "path": f.path.replace("\\", "/"),
+                "path": _norm_path(f.path),
                 "line": f.line,
                 "message": f.message,
             }

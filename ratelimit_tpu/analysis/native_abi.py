@@ -1,0 +1,541 @@
+"""`native-abi-contract`: the Python<->C boundary checker.
+
+backends/native_slot_table.py declares, in ctypes, what it believes
+the ``extern "C"`` surface of native/*.cpp looks like; nothing at
+runtime verifies the belief.  A drifted argtype width, a forgotten
+``restype`` (ctypes then defaults to a 32-bit int and truncates
+pointers and int64s), or a call into a symbol the .so no longer
+exports is a silent segfault or silent corruption — the worst failure
+class on the serving path.  This rule makes each of those a lint
+finding (extending the PR 7 dtype-pack-contract fold across the
+language boundary):
+
+1. **symbol set** — every ``extern "C"`` function must have a ctypes
+   ``argtypes`` declaration, and every declared symbol must exist in
+   the sources (a removed/renamed export is caught before the first
+   dlopen);
+2. **arity** — len(argtypes) == the C parameter count;
+3. **width/kind per parameter** — C pointers may be declared
+   ``c_void_p`` (the raw-address marshaling convention) or any ctypes
+   pointer; C scalars must match width and kind (``int64_t`` ==
+   c_int64/c_uint64, ``float`` == c_float, ...);
+4. **restype** — required for every non-void C function, must match
+   width/kind; a void function must not declare a value restype;
+5. **call-site dtype widths** — an array created in the binding module
+   with a known numpy dtype and passed (via the ``_ptr(...)`` raw-
+   address helper) to a C pointer parameter must have the pointee's
+   element width (`np.int32` buffer into a ``uint64_t*`` parameter is
+   an out-of-bounds write the moment n > 0) — the same layout-pin
+   discipline the dtype-pack-contract rule applies to LANE_DTYPE /
+   FLIGHT_DTYPE, extended to the FFI call sites.
+
+All findings anchor in the *binding module* (the .py side), so the
+engine's line-suppression machinery applies unchanged; messages name
+the C site (file:line) for navigation.
+
+Binding modules are discovered structurally: any indexed module that
+assigns ``<lib>.<symbol>.argtypes``.  The C sources are discovered by
+convention: the first directory containing ``*.cpp``/``*.cc``/``*.c``
+among the module's own directory and ``native/`` walking up to three
+levels (the in-tree layout: ``ratelimit_tpu/backends/`` ->
+``<repo>/native/``); fixtures put the C file next to the binding.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .cparse import CModel, CType, parse_sources
+from .engine import Finding
+from .project import ModuleInfo, ProjectIndex, ProjectRule
+
+# -- ctypes-side model -------------------------------------------------------
+
+#: ctypes name -> (kind, width, signed); pointers carry width 0 (the
+#: raw-address convention erases the pointee type on the Python side).
+_CTYPES: Dict[str, Tuple[str, int, bool]] = {
+    "c_bool": ("int", 1, False),
+    "c_char": ("int", 1, True),
+    "c_byte": ("int", 1, True),
+    "c_ubyte": ("int", 1, False),
+    "c_int8": ("int", 1, True),
+    "c_uint8": ("int", 1, False),
+    "c_int16": ("int", 2, True),
+    "c_uint16": ("int", 2, False),
+    "c_short": ("int", 2, True),
+    "c_ushort": ("int", 2, False),
+    "c_int": ("int", 4, True),
+    "c_uint": ("int", 4, False),
+    "c_int32": ("int", 4, True),
+    "c_uint32": ("int", 4, False),
+    "c_int64": ("int", 8, True),
+    "c_uint64": ("int", 8, False),
+    "c_longlong": ("int", 8, True),
+    "c_ulonglong": ("int", 8, False),
+    "c_size_t": ("int", 8, False),
+    "c_ssize_t": ("int", 8, True),
+    "c_float": ("float", 4, True),
+    "c_double": ("float", 8, True),
+    "c_void_p": ("pointer", 0, False),
+    "c_char_p": ("pointer", 0, False),
+}
+
+
+@dataclass
+class CTypesDecl:
+    """The binding module's declaration for one exported symbol."""
+
+    symbol: str
+    argtypes: Optional[List[str]] = None  # ctypes names; None = unset
+    restype: Optional[str] = None  # ctypes name | "void" | None = unset
+    argtypes_line: int = 1
+    restype_line: int = 1
+
+
+@dataclass
+class CallSiteArg:
+    """One ``lib.sym(...)`` positional argument whose numpy dtype the
+    binding module makes statically visible."""
+
+    symbol: str
+    index: int
+    dtype: str  # numpy dtype name, e.g. "int64"
+    line: int
+
+
+@dataclass
+class BindingModel:
+    module: ModuleInfo
+    decls: Dict[str, CTypesDecl] = field(default_factory=dict)
+    call_args: List[CallSiteArg] = field(default_factory=list)
+    anchor_line: int = 1  # first argtypes assignment: symbol-set anchor
+
+
+#: numpy dtype name -> element byte width (np.bool_ stores one byte —
+#: compatible with a uint8_t* out-parameter).
+_NP_WIDTHS: Dict[str, int] = {
+    "bool_": 1,
+    "uint8": 1,
+    "int8": 1,
+    "uint16": 2,
+    "int16": 2,
+    "uint32": 4,
+    "int32": 4,
+    "uint64": 8,
+    "int64": 8,
+    "float32": 4,
+    "float64": 8,
+}
+
+
+def _ctypes_name(node: ast.AST, env: Dict[str, str]) -> Optional[str]:
+    """Resolve an expression to a ctypes type name: ``ctypes.c_int64``,
+    a local alias bound from one, ``None`` (void), or unresolvable."""
+    if isinstance(node, ast.Constant) and node.value is None:
+        return "void"
+    if isinstance(node, ast.Attribute) and node.attr in _CTYPES:
+        return node.attr
+    if isinstance(node, ast.Name):
+        if node.id in _CTYPES:
+            return node.id
+        return env.get(node.id)
+    if isinstance(node, ast.Call):
+        # POINTER(...) / CFUNCTYPE(...): a typed pointer — fine for any
+        # C pointer parameter.
+        fname = ""
+        if isinstance(node.func, ast.Name):
+            fname = node.func.id
+        elif isinstance(node.func, ast.Attribute):
+            fname = node.func.attr
+        if fname in ("POINTER", "CFUNCTYPE"):
+            return "c_void_p"
+    return None
+
+
+def _collect_aliases(tree: ast.AST) -> Dict[str, str]:
+    """name -> ctypes name for simple aliases, including tuple form
+    (``i64, vp = ctypes.c_int64, ctypes.c_void_p``) at any scope."""
+    env: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt, val = node.targets[0], node.value
+        pairs: List[Tuple[ast.AST, ast.AST]] = []
+        if isinstance(tgt, ast.Name):
+            pairs.append((tgt, val))
+        elif (
+            isinstance(tgt, ast.Tuple)
+            and isinstance(val, ast.Tuple)
+            and len(tgt.elts) == len(val.elts)
+        ):
+            pairs.extend(zip(tgt.elts, val.elts))
+        for t, v in pairs:
+            if isinstance(t, ast.Name):
+                resolved = _ctypes_name(v, env)
+                if resolved and resolved != "void":
+                    env[t.id] = resolved
+    return env
+
+
+def _np_dtype_name(node: ast.AST) -> Optional[str]:
+    """``np.int64`` / ``numpy.uint32`` / ``"int64"`` -> dtype name."""
+    if isinstance(node, ast.Attribute) and node.attr in _NP_WIDTHS:
+        return node.attr
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if node.value in _NP_WIDTHS else None
+    return None
+
+
+#: numpy constructors whose dtype argument pins the element width:
+#: name -> positional index of dtype (after the first argument).
+_NP_CTORS = {
+    "empty": 1,
+    "zeros": 1,
+    "ones": 1,
+    "asarray": 1,
+    "ascontiguousarray": 1,
+    "frombuffer": 1,
+    "fromiter": 1,
+    "full": 2,
+    "array": 1,
+}
+
+
+def _array_dtype(node: ast.AST) -> Optional[str]:
+    """dtype name when `node` is a numpy constructor call with a
+    statically visible dtype."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else ""
+    )
+    if name not in _NP_CTORS:
+        return None
+    for kw in node.keywords:
+        if kw.arg == "dtype":
+            return _np_dtype_name(kw.value)
+    idx = _NP_CTORS[name]
+    if len(node.args) > idx:
+        return _np_dtype_name(node.args[idx])
+    return None
+
+
+class _BindingVisitor(ast.NodeVisitor):
+    """One walk over the binding module collecting the ctypes table
+    and the statically-typed FFI call-site arguments."""
+
+    def __init__(self, env: Dict[str, str]):
+        self.env = env
+        self.decls: Dict[str, CTypesDecl] = {}
+        self.call_args: List[CallSiteArg] = []
+        self.anchor_line: Optional[int] = None
+        # per enclosing function: local array name -> dtype name
+        self._dtype_scope: List[Dict[str, str]] = [{}]
+
+    # -- declarations ------------------------------------------------
+
+    def _decl(self, symbol: str) -> CTypesDecl:
+        return self.decls.setdefault(symbol, CTypesDecl(symbol))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if len(node.targets) == 1:
+            tgt = node.targets[0]
+            # <lib expr>.<symbol>.(argtypes|restype) = ...
+            if (
+                isinstance(tgt, ast.Attribute)
+                and tgt.attr in ("argtypes", "restype")
+                and isinstance(tgt.value, ast.Attribute)
+            ):
+                symbol = tgt.value.attr
+                decl = self._decl(symbol)
+                if tgt.attr == "argtypes":
+                    if self.anchor_line is None:
+                        self.anchor_line = node.lineno
+                    decl.argtypes_line = node.lineno
+                    if isinstance(node.value, (ast.List, ast.Tuple)):
+                        decl.argtypes = [
+                            _ctypes_name(e, self.env) or "?"
+                            for e in node.value.elts
+                        ]
+                else:
+                    decl.restype_line = node.lineno
+                    decl.restype = _ctypes_name(node.value, self.env)
+            # local array binding: name = np.empty(..., dtype=np.X)
+            if isinstance(tgt, ast.Name):
+                dt = _array_dtype(node.value)
+                if dt:
+                    self._dtype_scope[-1][tgt.id] = dt
+                elif tgt.id in self._dtype_scope[-1]:
+                    del self._dtype_scope[-1][tgt.id]  # rebound opaquely
+        self.generic_visit(node)
+
+    # -- call sites ---------------------------------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._dtype_scope.append({})
+        self.generic_visit(node)
+        self._dtype_scope.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if (
+            isinstance(fn, ast.Attribute)
+            and isinstance(fn.value, ast.Attribute)
+            and fn.value.attr == "_lib"
+            or isinstance(fn, ast.Attribute)
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id in ("lib", "_lib")
+        ):
+            symbol = fn.attr
+            if symbol in self.decls or symbol.startswith(("sk_", "rl_")):
+                for i, arg in enumerate(node.args):
+                    dt = self._arg_dtype(arg)
+                    if dt is not None:
+                        self.call_args.append(
+                            CallSiteArg(symbol, i, dt, node.lineno)
+                        )
+        self.generic_visit(node)
+
+    def _arg_dtype(self, arg: ast.AST) -> Optional[str]:
+        """dtype of an argument of the form ``_ptr(x)`` /
+        ``self._ptr(x)`` where x's dtype is visible in this scope, or
+        a direct constructor call ``_ptr(np.empty(.., np.X))``."""
+        if not (isinstance(arg, ast.Call) and len(arg.args) == 1):
+            return None
+        fn = arg.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else ""
+        )
+        if name != "_ptr":
+            return None
+        inner = arg.args[0]
+        direct = _array_dtype(inner)
+        if direct:
+            return direct
+        if isinstance(inner, ast.Name):
+            return self._dtype_scope[-1].get(inner.id)
+        return None
+
+
+def parse_binding_module(mod: ModuleInfo) -> Optional[BindingModel]:
+    """BindingModel when `mod` declares a ctypes signature table."""
+    env = _collect_aliases(mod.tree)
+    v = _BindingVisitor(env)
+    v.visit(mod.tree)
+    if not any(d.argtypes is not None for d in v.decls.values()):
+        return None
+    return BindingModel(
+        module=mod,
+        decls=v.decls,
+        call_args=v.call_args,
+        anchor_line=v.anchor_line or 1,
+    )
+
+
+# -- C source discovery ------------------------------------------------------
+
+_C_GLOBS = ("*.cpp", "*.cc", "*.c")
+
+
+def find_native_sources(module_path: str) -> List[str]:
+    """C sources for a binding module, by convention: the module's own
+    directory, then ``native/`` beside each of up to three ancestor
+    directories (in-tree: ratelimit_tpu/backends -> <repo>/native)."""
+    here = os.path.dirname(os.path.abspath(module_path))
+    candidates = [here]
+    d = here
+    for _ in range(3):
+        d = os.path.dirname(d)
+        candidates.append(os.path.join(d, "native"))
+    for cand in candidates:
+        hits: List[str] = []
+        for pat in _C_GLOBS:
+            hits.extend(glob.glob(os.path.join(cand, pat)))
+        if hits:
+            return sorted(hits)
+    return []
+
+
+# -- the rule ----------------------------------------------------------------
+
+
+def _compatible(c: CType, ctname: str) -> bool:
+    kind, width, _signed = _CTYPES.get(ctname, ("?", -1, False))
+    if c.is_pointer:
+        return kind == "pointer"
+    if kind == "pointer":
+        return False
+    # scalar: same kind and width; signedness is a marshaling no-op
+    return kind == c.kind and width == c.width
+
+
+def _rel(path: str) -> str:
+    try:
+        return os.path.relpath(path)
+    except ValueError:  # pragma: no cover - cross-drive on windows
+        return path
+
+
+class NativeAbiContractRule(ProjectRule):
+    """Cross-language ABI drift at the ctypes boundary."""
+
+    id = "native-abi-contract"
+    description = (
+        "extern-C signature vs ctypes argtypes/restype/dtype drift"
+    )
+
+    def check_project(self, index: ProjectIndex) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in index.modules.values():
+            binding = parse_binding_module(mod)
+            if binding is None:
+                continue
+            srcs = find_native_sources(mod.path)
+            if not srcs:
+                continue  # no sources to check against (binary-only)
+            cmodel = parse_sources(srcs)
+            findings.extend(self._check(binding, cmodel))
+        return findings
+
+    def _check(
+        self, binding: BindingModel, cmodel: CModel
+    ) -> List[Finding]:
+        out: List[Finding] = []
+        path = binding.module.path
+
+        def report(line: int, message: str) -> None:
+            out.append(
+                Finding(
+                    rule_id=self.id,
+                    path=path,
+                    line=line,
+                    col=0,
+                    message=message,
+                )
+            )
+
+        declared = {
+            s for s, d in binding.decls.items() if d.argtypes is not None
+        }
+        exported = set(cmodel.functions)
+
+        for sym in sorted(exported - declared):
+            fn = cmodel.functions[sym]
+            report(
+                binding.anchor_line,
+                f"extern \"C\" symbol {sym} "
+                f"({_rel(fn.path)}:{fn.line}) has no ctypes argtypes "
+                "declaration: an undeclared call marshals every "
+                "argument as a 32-bit default",
+            )
+        for sym in sorted(declared - exported):
+            d = binding.decls[sym]
+            report(
+                d.argtypes_line,
+                f"ctypes declares {sym} but no extern \"C\" function "
+                "of that name exists in "
+                f"{', '.join(_rel(p) for p in cmodel.paths)}: removed "
+                "or renamed export (load would fail or bind a stale "
+                "symbol)",
+            )
+
+        for sym in sorted(declared & exported):
+            d = binding.decls[sym]
+            fn = cmodel.functions[sym]
+            assert d.argtypes is not None
+            if len(d.argtypes) != len(fn.params):
+                report(
+                    d.argtypes_line,
+                    f"{sym}: argtypes declares {len(d.argtypes)} "
+                    f"parameter(s) but the C signature "
+                    f"({_rel(fn.path)}:{fn.line}) takes "
+                    f"{len(fn.params)} — every argument after the "
+                    "mismatch lands in the wrong register",
+                )
+            else:
+                for i, (ctname, param) in enumerate(
+                    zip(d.argtypes, fn.params)
+                ):
+                    if param.ctype.kind == "unknown":
+                        continue  # lexer punt: never guess
+                    if not _compatible(param.ctype, ctname):
+                        pname = param.name or f"#{i}"
+                        report(
+                            d.argtypes_line,
+                            f"{sym}: argtypes[{i}] is {ctname} but C "
+                            f"parameter {pname} "
+                            f"({_rel(fn.path)}:{fn.line}) is "
+                            f"{param.ctype.describe()} — width/kind "
+                            "drift corrupts the argument registers",
+                        )
+            self._check_restype(report, d, fn)
+
+        # call-site dtype widths vs pointee widths
+        for ca in binding.call_args:
+            fn = cmodel.functions.get(ca.symbol)
+            if fn is None or ca.index >= len(fn.params):
+                continue
+            c = fn.params[ca.index].ctype
+            if not c.is_pointer or c.kind in ("void", "unknown"):
+                continue
+            got = _NP_WIDTHS.get(ca.dtype)
+            if got is not None and got != c.width:
+                pname = fn.params[ca.index].name or f"#{ca.index}"
+                report(
+                    ca.line,
+                    f"{ca.symbol}: argument {ca.index} is a "
+                    f"np.{ca.dtype} buffer ({got}-byte elements) but "
+                    f"C parameter {pname} "
+                    f"({_rel(fn.path)}:{fn.line}) is "
+                    f"{c.describe()} — element width mismatch "
+                    "reads/writes out of bounds",
+                )
+        return out
+
+    @staticmethod
+    def _check_restype(report, d: CTypesDecl, fn) -> None:
+        returns_void = fn.ret.kind == "void" and not fn.ret.is_pointer
+        if returns_void:
+            if d.restype not in (None, "void"):
+                report(
+                    d.restype_line,
+                    f"{d.symbol}: restype {d.restype} declared but the "
+                    f"C function ({_rel(fn.path)}:{fn.line}) returns "
+                    "void — the read value is garbage",
+                )
+            elif d.restype is None:
+                report(
+                    d.argtypes_line,
+                    f"{d.symbol}: C function returns void but restype "
+                    "is never set — ctypes defaults to c_int and "
+                    "reads a stale register; set restype = None",
+                )
+            return
+        if d.restype in (None, "void"):
+            report(
+                d.argtypes_line,
+                f"{d.symbol}: C function "
+                f"({_rel(fn.path)}:{fn.line}) returns "
+                f"{fn.ret.describe()} but restype is "
+                f"{'never set' if d.restype is None else 'None'} — "
+                "ctypes' default c_int truncates 64-bit returns",
+            )
+            return
+        if not _compatible(fn.ret, d.restype):
+            report(
+                d.restype_line,
+                f"{d.symbol}: restype {d.restype} but the C function "
+                f"({_rel(fn.path)}:{fn.line}) returns "
+                f"{fn.ret.describe()} — width/kind drift",
+            )
+
+
+def make_native_abi_rules() -> List[ProjectRule]:
+    return [NativeAbiContractRule()]

@@ -836,8 +836,15 @@ def _make_default_project_rules():
     """The whole-program rule pack (fresh instances, same contract)."""
     from .concurrency import make_concurrency_rules
     from .contracts import make_contract_rules
+    from .hotpath import make_hotpath_rules
+    from .native_abi import make_native_abi_rules
 
-    return make_concurrency_rules() + make_contract_rules()
+    return (
+        make_concurrency_rules()
+        + make_contract_rules()
+        + make_native_abi_rules()
+        + make_hotpath_rules()
+    )
 
 
 DEFAULT_PROJECT_RULES = _make_default_project_rules()

@@ -101,6 +101,7 @@ def _signatures(lib: ctypes.CDLL) -> None:
     i64, vp = ctypes.c_int64, ctypes.c_void_p
     lib.sk_create.restype = vp
     lib.sk_create.argtypes = [i64]
+    lib.sk_destroy.restype = None
     lib.sk_destroy.argtypes = [vp]
     lib.sk_len.restype = i64
     lib.sk_len.argtypes = [vp]
@@ -110,7 +111,9 @@ def _signatures(lib: ctypes.CDLL) -> None:
     lib.sk_arena_bytes.argtypes = [vp]
     lib.sk_gc.restype = i64
     lib.sk_gc.argtypes = [vp, i64]
+    lib.sk_begin_batch.restype = None
     lib.sk_begin_batch.argtypes = [vp]
+    lib.sk_end_batch.restype = None
     lib.sk_end_batch.argtypes = [vp]
     lib.sk_assign_batch.restype = i64
     lib.sk_assign_batch.argtypes = [vp, vp, vp, i64, i64, vp, vp, vp]
@@ -121,6 +124,7 @@ def _signatures(lib: ctypes.CDLL) -> None:
     ]
     lib.sk_export_size.restype = i64
     lib.sk_export_size.argtypes = [vp, vp]
+    lib.sk_export.restype = None
     lib.sk_export.argtypes = [vp, vp, vp, vp, vp]
     lib.sk_import.restype = i64
     lib.sk_import.argtypes = [vp, vp, vp, vp, vp, i64]
@@ -133,12 +137,109 @@ def _signatures(lib: ctypes.CDLL) -> None:
     ]
 
 
+def expected_symbols() -> frozenset:
+    """Every symbol the ctypes table declares, derived from
+    _signatures itself (single source of truth: a symbol added there
+    is automatically part of the load-time preflight)."""
+
+    class _Slot:
+        def __init__(self):
+            self.__dict__ = {}
+
+    class _Recorder:
+        def __init__(self):
+            self.names = set()
+
+        def __getattr__(self, name):
+            self.names.add(name)
+            slot = _Slot()
+            self.__dict__[name] = slot
+            return slot
+
+    rec = _Recorder()
+    _signatures(rec)  # type: ignore[arg-type]
+    return frozenset(rec.names)
+
+
+def _missing_symbols(lib: ctypes.CDLL) -> List[str]:
+    missing = []
+    for name in sorted(expected_symbols()):
+        if not hasattr(lib, name):
+            missing.append(name)
+    return missing
+
+
+def _staleness_hint() -> str:
+    """One-line mtime comparison for the load-failure message.  The
+    stamp (content hash) is the rebuild authority; mtimes are only
+    quoted as a human-readable hint about HOW the tree got stale."""
+    try:
+        so_mtime = os.path.getmtime(_SO)
+        src_mtime = max(os.path.getmtime(s) for s in _SRCS)
+    except OSError:
+        return ""
+    if so_mtime < src_mtime:
+        return (
+            " (.so predates native/*.cpp by "
+            f"{src_mtime - so_mtime:.0f}s — stale build)"
+        )
+    return ""
+
+
+def _verify_symbols(lib: ctypes.CDLL, path: str) -> bool:
+    """Preflight the exported symbol set BEFORE any signature is
+    declared, so a stale/foreign .so fails the load with a rebuild
+    hint instead of an AttributeError at first call."""
+    missing = _missing_symbols(lib)
+    if not missing:
+        return True
+    logger.warning(
+        "native library %s is missing exported symbol(s) %s%s; "
+        "run `make native` to rebuild",
+        path,
+        ", ".join(missing),
+        _staleness_hint(),
+    )
+    return False
+
+
+def loaded_path() -> Optional[str]:
+    """Path of the .so actually loaded (None when unavailable) — the
+    sanitizer harness asserts the instrumented build is in use."""
+    lib = _get_lib()
+    return getattr(lib, "_name", None) if lib is not None else None
+
+
 def _get_lib() -> Optional[ctypes.CDLL]:
     global _LIB, _LIB_FAILED
     if _LIB is not None or _LIB_FAILED:
         return _LIB
     with _LIB_LOCK:
         if _LIB is not None or _LIB_FAILED:
+            return _LIB
+        # Tooling override: load a pre-built library verbatim (the
+        # ASan/UBSan side build from scripts/sanitize_native.py),
+        # never rebuilding over it.
+        override = os.environ.get(  # tpu-lint: disable=env-discipline -- build-tooling seam: the sanitizer harness pins its instrumented .so; not runtime configuration
+            "TPU_NATIVE_SO"
+        )
+        if override:
+            try:
+                lib = ctypes.CDLL(override)
+            except OSError as e:
+                logger.warning(
+                    "TPU_NATIVE_SO=%s failed to load (%s); native "
+                    "table disabled",
+                    override,
+                    e,
+                )
+                _LIB_FAILED = True
+                return None
+            if not _verify_symbols(lib, override):
+                _LIB_FAILED = True
+                return None
+            _signatures(lib)
+            _LIB = lib
             return _LIB
         digest = _src_digest()
         stamp = None
@@ -161,24 +262,30 @@ def _get_lib() -> Optional[ctypes.CDLL]:
         if needs_build and not _build(digest):
             _LIB_FAILED = True
             return None
-        try:
-            lib = ctypes.CDLL(_SO)
-            _signatures(lib)
-            _LIB = lib
-        except (OSError, AttributeError) as e:
-            # AttributeError: a stale .so (newer mtime than the
-            # sources, e.g. a cached build artifact) loaded but lacks
-            # a newer symbol — rebuild once, then fall back to Python.
-            if _build():
-                try:
-                    lib = ctypes.CDLL(_SO)
-                    _signatures(lib)
-                    _LIB = lib
-                    return _LIB
-                except (OSError, AttributeError):
-                    pass
-            logger.warning("native slot table load failed (%s); using Python", e)
-            _LIB_FAILED = True
+        # Load + preflight the whole expected symbol set up front: a
+        # stale .so (e.g. a cached build artifact with a satisfied
+        # stamp) fails HERE with a `make native` hint, never with an
+        # AttributeError at the first call — rebuild once, then fall
+        # back to Python.
+        err: object = "missing exported symbols"
+        for attempt in (0, 1):
+            try:
+                lib = ctypes.CDLL(_SO)
+            except OSError as e:
+                err = e
+                lib = None
+            if lib is not None and _verify_symbols(lib, _SO):
+                _signatures(lib)
+                _LIB = lib
+                return _LIB
+            if attempt == 0 and not _build():
+                break
+        logger.warning(
+            "native slot table load failed (%s); using Python — "
+            "run `make native` to rebuild",
+            err,
+        )
+        _LIB_FAILED = True
     return _LIB
 
 

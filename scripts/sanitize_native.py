@@ -1,0 +1,185 @@
+"""`make sanitize-native`: the C++ hot path under ASan+UBSan.
+
+Side-path build (never touches the production _libslottable.so or its
+content stamp): compiles native/*.cpp with
+``-fsanitize=address,undefined -fno-omit-frame-pointer`` into
+``backends/_libslottable_asan.so``, then re-runs the native
+differential suites (test_native_slot_table.py, test_native_decide.py)
+and the seeded randomized fuzzer (scripts/fuzz_native.py) with the
+loader pinned to the instrumented library via ``TPU_NATIVE_SO``.
+
+The sanitizer runtimes must be present in the interpreter before the
+instrumented .so is dlopen'd, so the child processes run under
+``LD_PRELOAD=libasan.so libubsan.so`` (resolved from the same g++
+that built the library).  Leak checking is off — CPython's arena
+allocator is full of intentional immortal allocations — but every
+other ASan class plus all UBSan checks are fatal
+(``-fno-sanitize-recover=all``).
+
+Toolchain detection is graceful: a missing or pre-C++20 g++ (or
+missing sanitizer runtimes — some minimal images strip them) prints a
+one-line skip reason and exits 0, so `make ci` stays green on images
+without the toolchain (docs/STATIC_ANALYSIS.md).
+"""
+
+import argparse
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from ratelimit_tpu.backends import native_slot_table as nst
+
+ASAN_SO = os.path.join(os.path.dirname(nst._SO), "_libslottable_asan.so")
+
+#: g++ major that reliably supports -std=c++20 + address,undefined.
+MIN_GXX_MAJOR = 10
+
+CXXFLAGS = [
+    "-O1",
+    "-g",
+    "-std=c++20",
+    "-shared",
+    "-fPIC",
+    "-fno-omit-frame-pointer",
+    "-fsanitize=address,undefined",
+    "-fno-sanitize-recover=all",
+]
+
+
+def _skip(reason):
+    print(f"sanitize-native: SKIP — {reason}")
+    return 0
+
+
+def _gxx_major():
+    out = subprocess.run(
+        ["g++", "-dumpversion"], capture_output=True, text=True, timeout=30
+    ).stdout.strip()
+    m = re.match(r"(\d+)", out)
+    return int(m.group(1)) if m else 0
+
+
+def _runtime_libs():
+    """Absolute paths of libasan/libubsan as the building g++ resolves
+    them; [] when the image stripped the runtimes."""
+    libs = []
+    for name in ("libasan.so", "libubsan.so"):
+        out = subprocess.run(
+            ["g++", f"-print-file-name={name}"],
+            capture_output=True,
+            text=True,
+            timeout=30,
+        ).stdout.strip()
+        if not os.path.isabs(out) or not os.path.exists(out):
+            return []
+        libs.append(out)
+    return libs
+
+
+def build():
+    srcs = [s for s in nst._SRCS if os.path.exists(s)]
+    if len(srcs) != len(nst._SRCS):
+        return None, "native sources missing"
+    tmp = f"{ASAN_SO}.tmp.{os.getpid()}"
+    try:
+        subprocess.run(
+            ["g++", *CXXFLAGS, "-o", tmp, *srcs],
+            check=True,
+            capture_output=True,
+            timeout=240,
+        )
+        os.replace(tmp, ASAN_SO)
+        return ASAN_SO, None
+    except subprocess.CalledProcessError as e:
+        sys.stderr.write(e.stderr.decode(errors="replace"))
+        return None, "instrumented build failed"
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def _child_env(libs):
+    env = dict(os.environ)
+    env.update(
+        TPU_NATIVE_SO=ASAN_SO,
+        LD_PRELOAD=" ".join(libs),
+        ASAN_OPTIONS="detect_leaks=0:abort_on_error=1",
+        UBSAN_OPTIONS="print_stacktrace=1:halt_on_error=1",
+        JAX_PLATFORMS="cpu",
+    )
+    return env
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--build-only",
+        action="store_true",
+        help="compile the instrumented library and stop (make native-asan)",
+    )
+    ap.add_argument("--batches", type=int, default=10_000)
+    ap.add_argument("--seed", type=int, default=20260806)
+    args = ap.parse_args(argv)
+
+    if shutil.which("g++") is None:
+        return _skip("g++ not on PATH")
+    major = _gxx_major()
+    if major < MIN_GXX_MAJOR:
+        return _skip(f"g++ {major} < {MIN_GXX_MAJOR} (need c++20 + asan)")
+    libs = _runtime_libs()
+    if not libs:
+        return _skip("libasan/libubsan runtimes not installed")
+
+    so, err = build()
+    if so is None:
+        return _skip(err)
+    print(f"sanitize-native: built {os.path.relpath(so, REPO)}")
+    if args.build_only:
+        return 0
+
+    env = _child_env(libs)
+    steps = [
+        (
+            "differential suites under ASan+UBSan",
+            [
+                sys.executable,
+                "-m",
+                "pytest",
+                "tests/test_native_slot_table.py",
+                "tests/test_native_decide.py",
+                "-q",
+                "-p",
+                "no:cacheprovider",
+            ],
+        ),
+        (
+            f"{args.batches}-batch differential fuzz under ASan+UBSan",
+            [
+                sys.executable,
+                "scripts/fuzz_native.py",
+                "--batches",
+                str(args.batches),
+                "--seed",
+                str(args.seed),
+            ],
+        ),
+    ]
+    for title, cmd in steps:
+        print(f"sanitize-native: {title}", flush=True)
+        rc = subprocess.run(cmd, env=env, cwd=REPO).returncode
+        if rc != 0:
+            print(f"sanitize-native: FAIL — {title} (exit {rc})")
+            return rc
+    print("sanitize-native: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

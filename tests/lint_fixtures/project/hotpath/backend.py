@@ -1,0 +1,24 @@
+"""Fixture: allocation hazards reached THROUGH a typed attribute from
+the request path (hot-path-cost true positives with a cross-module
+cause)."""
+
+
+class Config:
+    def __init__(self):
+        self.scale = 2
+
+
+class Backend:
+    def __init__(self):
+        self.cfg = Config()
+
+    def process(self, limits):
+        out = []
+        for d in limits:
+            label = f"{d}-row"  # finding: f-string per iteration
+            picked = [x for x in (label,) if x]  # finding: comprehension
+            out.append(
+                # finding: self.cfg.scale loaded 3x in one loop
+                (self.cfg.scale, self.cfg.scale + self.cfg.scale, picked)
+            )
+        return out

@@ -1,0 +1,15 @@
+from .backend import Backend
+
+
+class Service:
+    def __init__(self):
+        self.backend = Backend()
+
+    def do_limit(self, request, limits):
+        key_fn = lambda d: d.key  # finding: lambda per request
+
+        def tag(row):  # finding: nested def per request
+            return (request, row)
+
+        rows = self.backend.process(limits)
+        return sorted((tag(r) for r in rows), key=key_fn)

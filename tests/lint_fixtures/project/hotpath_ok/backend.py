@@ -1,0 +1,17 @@
+"""Fixture: the same hazard shapes placed OFF the request path (or
+outside loops) must not fire."""
+
+
+class Backend:
+    def process(self, limits):
+        out = []
+        for d in limits:
+            out.append(d)
+        return out
+
+    def report(self, limits):
+        # not reachable from any request-path root: free to allocate
+        lines = []
+        for d in limits:
+            lines.append(f"{d}-row")
+        return "\n".join(lines)

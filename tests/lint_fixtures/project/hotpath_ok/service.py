@@ -1,0 +1,12 @@
+from .backend import Backend
+
+
+class Service:
+    def __init__(self):
+        self.backend = Backend()
+
+    def do_limit(self, request, limits):
+        header = f"{request}-batch"  # outside any loop: not a finding
+        rows = self.backend.process(limits)
+        probe = lambda d: d  # tpu-lint: disable=hot-path-cost -- fixture: measured at <1us, dwarfed by the backend RPC
+        return sorted(rows, key=probe), header

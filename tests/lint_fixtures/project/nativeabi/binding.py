@@ -1,0 +1,28 @@
+"""Drifted ctypes binding for native_src.cpp (see the .cpp header for
+the four seeded disagreements; a fifth is the call-site dtype drift
+in run_sum below)."""
+
+import ctypes
+
+import numpy as np
+
+i64, vp = ctypes.c_int64, ctypes.c_void_p
+
+
+def _signatures(lib):
+    lib.rl_sum.restype = i64
+    lib.rl_sum.argtypes = [vp, ctypes.c_int32]  # C says int64_t: drift
+    lib.rl_reset.restype = None
+    lib.rl_reset.argtypes = [vp]
+    lib.rl_count.argtypes = [vp]  # returns int64_t, restype never set
+    lib.rl_gone.restype = i64  # no such extern "C" function anymore
+    lib.rl_gone.argtypes = [vp]
+
+
+def _ptr(a):
+    return a.ctypes.data
+
+
+def run_sum(lib, n):
+    xs = np.empty(n, dtype=np.int32)  # C reads int64_t*: width drift
+    return lib.rl_sum(_ptr(xs), n)

@@ -1,0 +1,33 @@
+// Drifted native surface for the native-abi-contract fixtures: the
+// binding (binding.py) disagrees with this file in four distinct
+// ways (width, removed symbol, undeclared symbol, missing restype).
+#include <cstdint>
+
+namespace {
+constexpr uint64_t kFixtureMax = 0xFF;
+}
+
+extern "C" {
+
+// binding declares argtypes[1] = c_int32: WIDTH DRIFT (int64_t here).
+int64_t rl_sum(const int64_t* xs, int64_t n) {
+  int64_t s = 0;
+  for (int64_t i = 0; i < n; ++i) s += xs[i];
+  return s;
+}
+
+void rl_reset(void* h) { (void)h; }
+
+// binding sets argtypes but never restype: MISSING RESTYPE.
+int64_t rl_count(void* h) {
+  (void)h;
+  return static_cast<int64_t>(kFixtureMax);
+}
+
+// not declared in the binding at all: UNDECLARED EXPORT.
+uint32_t rl_extra(void* h) {
+  (void)h;
+  return 7u;
+}
+
+}  // extern "C"

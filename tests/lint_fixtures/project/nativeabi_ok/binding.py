@@ -1,0 +1,28 @@
+"""Clean ctypes binding: every declaration and call-site dtype agrees
+with native_src.cpp."""
+
+import ctypes
+
+import numpy as np
+
+i64, vp = ctypes.c_int64, ctypes.c_void_p
+
+
+def _signatures(lib):
+    lib.rl_sum.restype = i64
+    lib.rl_sum.argtypes = [vp, i64]
+    lib.rl_reset.restype = None
+    lib.rl_reset.argtypes = [vp]
+    lib.rl_fill.restype = None
+    lib.rl_fill.argtypes = [vp, i64, ctypes.c_float]
+
+
+def _ptr(a):
+    return a.ctypes.data
+
+
+def run(lib, n):
+    xs = np.empty(n, dtype=np.int64)
+    out = np.zeros(n, dtype=np.uint32)
+    lib.rl_fill(_ptr(out), n, ctypes.c_float(2.0))
+    return lib.rl_sum(_ptr(xs), n)

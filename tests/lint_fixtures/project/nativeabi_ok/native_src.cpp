@@ -1,0 +1,18 @@
+// Clean native surface: binding.py mirrors this file exactly.
+#include <cstdint>
+
+extern "C" {
+
+int64_t rl_sum(const int64_t* xs, int64_t n) {
+  int64_t s = 0;
+  for (int64_t i = 0; i < n; ++i) s += xs[i];
+  return s;
+}
+
+void rl_reset(void* h) { (void)h; }
+
+void rl_fill(uint32_t* out, int64_t n, float scale) {
+  for (int64_t i = 0; i < n; ++i) out[i] = static_cast<uint32_t>(i * scale);
+}
+
+}  // extern "C"

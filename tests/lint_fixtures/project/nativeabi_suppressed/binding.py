@@ -1,0 +1,11 @@
+"""Same width drift as nativeabi/, carrying a justified suppression
+on the finding's line."""
+
+import ctypes
+
+i64, vp = ctypes.c_int64, ctypes.c_void_p
+
+
+def _signatures(lib):
+    lib.rl_sum.restype = i64
+    lib.rl_sum.argtypes = [vp, ctypes.c_int32]  # tpu-lint: disable=native-abi-contract -- fixture: pretend the C side widens next release

@@ -1,0 +1,13 @@
+// One seeded width drift; the binding suppresses it with a
+// justification (the suppression-honored leg of the fixture trio).
+#include <cstdint>
+
+extern "C" {
+
+int64_t rl_sum(const int64_t* xs, int64_t n) {
+  int64_t s = 0;
+  for (int64_t i = 0; i < n; ++i) s += xs[i];
+  return s;
+}
+
+}  // extern "C"

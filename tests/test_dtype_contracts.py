@@ -145,3 +145,115 @@ def test_static_model_matches_live_lane_dtype():
     assert [f[0] for f in decl.fields] == list(LANE_DTYPE.names)
     for name in LANE_DTYPE.names:
         assert decl.offsets[name] == LANE_DTYPE.fields[name][1], name
+
+
+# -- the ctypes boundary: sk_assign_dedup_batch (ISSUE 16 satellite) ---------
+#
+# The fused dedup entry moves ten buffers across the FFI in one call
+# and its group outputs feed the int32[5, padded] device pack that
+# engine.py hands to step_serve_packed.  Pin all three layers against
+# each other: the static C parser model, the live ctypes table, and
+# the numpy dtypes of the buffers that cross.
+
+import ctypes
+
+from ratelimit_tpu.analysis.cparse import parse_sources
+from ratelimit_tpu.analysis.native_abi import find_native_sources
+from ratelimit_tpu.backends import native_slot_table as nst
+
+#: The agreed C signature, (param name, rendered type), in order.
+_DEDUP_C_SIG = [
+    ("tp", "void*"),
+    ("key_blob", "uint8_t*"),
+    ("key_lens", "int64_t*"),
+    ("n", "int64_t"),
+    ("now", "int64_t"),
+    ("expiries", "int64_t*"),
+    ("hits", "uint32_t*"),
+    ("limits", "uint32_t*"),
+    ("out_group", "int32_t*"),
+    ("out_uniq", "int32_t*"),
+    ("out_totals", "uint64_t*"),
+    ("out_prefix", "uint64_t*"),
+    ("out_freshg", "uint8_t*"),
+    ("out_limitmax", "uint32_t*"),
+]
+
+#: numpy dtype of each buffer the binding allocates/passes for the
+#: pointer parameters above (native_slot_table.assign_dedup_packed).
+_DEDUP_BUFFER_DTYPES = {
+    "key_lens": np.int64,
+    "expiries": np.int64,
+    "hits": np.uint32,
+    "limits": np.uint32,
+    "out_group": np.int32,
+    "out_uniq": np.int32,
+    "out_totals": np.uint64,
+    "out_prefix": np.uint64,
+    "out_freshg": np.uint8,
+    "out_limitmax": np.uint32,
+}
+
+
+def _dedup_c_model():
+    binding = "ratelimit_tpu/backends/native_slot_table.py"
+    model = parse_sources(find_native_sources(binding))
+    return model.functions["sk_assign_dedup_batch"]
+
+
+def test_dedup_batch_static_c_signature_pinned():
+    fn = _dedup_c_model()
+    assert fn.ret.describe() == "int64_t"
+    got = [(p.name, p.ctype.describe()) for p in fn.params]
+    assert got == _DEDUP_C_SIG
+
+
+def test_dedup_buffer_dtypes_match_c_pointee_widths():
+    """Each numpy buffer that crosses the boundary has exactly the C
+    pointee's element width — the runtime twin of the rule's
+    call-site leg (an np.int32 buffer under a uint64_t* parameter is
+    an out-of-bounds write the moment n > 0)."""
+    fn = _dedup_c_model()
+    by_name = {p.name: p.ctype for p in fn.params}
+    for name, np_dtype in _DEDUP_BUFFER_DTYPES.items():
+        c = by_name[name]
+        assert c.is_pointer, name
+        assert np.dtype(np_dtype).itemsize == c.width, name
+
+
+def test_dedup_batch_live_argtypes_match_static():
+    """The live ctypes table (pointer params as c_void_p raw
+    addresses, scalars at the C width) agrees with the parsed
+    signature — on the actually-loaded library when present."""
+    if not nst.available():
+        import pytest
+
+        pytest.skip("native library unavailable in this environment")
+    lib = ctypes.CDLL(nst.loaded_path())
+    nst._signatures(lib)
+    fn = _dedup_c_model()
+    at = lib.sk_assign_dedup_batch.argtypes
+    assert len(at) == len(fn.params) == 14
+    for ct, param in zip(at, fn.params):
+        if param.ctype.is_pointer:
+            assert ct is ctypes.c_void_p, param.name
+        else:
+            assert ctypes.sizeof(ct) == param.ctype.width, param.name
+    assert ctypes.sizeof(lib.sk_assign_dedup_batch.restype) == 8
+
+
+def test_packed_transfer_u32_bit_views_are_lossless():
+    """engine.py ships the dedup group outputs device-ward as an
+    int32[5, padded] pack, reinterpreting the u32 rows (totals,
+    limit_max, divider_max) via .view(np.int32).  That is only sound
+    because the views are bit-exact both ways at width 4 — pinned
+    here against the u32 saturation ceiling the native side clamps
+    to (kU32Max)."""
+    fn = _dedup_c_model()
+    hits_c = {p.name: p.ctype for p in fn.params}["hits"]
+    assert np.dtype(np.int32).itemsize == hits_c.width == 4
+    totals = np.array([0, 1, 0x7FFFFFFF, 0x80000000, 0xFFFFFFFF], np.uint32)
+    assert (totals.view(np.int32).view(np.uint32) == totals).all()
+    # LANE_DTYPE's u32 counters are what those buffers are built from.
+    assert LANE_DTYPE.fields["hits"][0] == np.dtype(np.uint32)
+    assert LANE_DTYPE.fields["limits"][0] == np.dtype(np.uint32)

@@ -21,6 +21,7 @@ from ratelimit_tpu.analysis.baseline import (
 )
 from ratelimit_tpu.analysis.concurrency import make_concurrency_rules
 from ratelimit_tpu.analysis.contracts import make_contract_rules
+from ratelimit_tpu.analysis.hotpath import make_hotpath_rules
 from ratelimit_tpu.analysis.engine import Finding, analyze_paths
 from ratelimit_tpu.analysis.project import ProjectIndex, module_name_for
 from ratelimit_tpu.analysis.engine import build_context
@@ -36,7 +37,9 @@ def project_findings(subdir):
     findings, _ = analyze_paths(
         [str(FIXTURES / subdir)],
         rules=[],
-        project_rules=make_concurrency_rules() + make_contract_rules(),
+        project_rules=make_concurrency_rules()
+        + make_contract_rules()
+        + make_hotpath_rules(),
     )
     return findings
 
@@ -290,25 +293,38 @@ def test_cli_fail_on_new_flags_regressions(tmp_path, capsys):
     assert "lock-order-cycle" in out
 
 
-def test_committed_baseline_is_empty_at_head():
-    """The tree is clean, so the committed ratchet file must hold
-    zero findings — a grown baseline is a conscious, reviewed change,
-    never drift."""
+def test_committed_baseline_is_hotpath_ratchet_only():
+    """The committed ratchet may hold ONLY the hot-path-cost backlog
+    (the pre-existing allocation debt on the serving path).  Every
+    other rule — including native-abi-contract — must be clean at
+    HEAD with no baseline cover, and the backlog can only shrink:
+    regenerating the file is a conscious, reviewed change, never
+    drift."""
     doc = load_baseline()
-    assert doc["findings"] == []
+    rules = {e["rule"] for e in doc["findings"]}
+    assert rules <= {"hot-path-cost"}, sorted(rules)
+    assert doc["findings"], "ratchet emptied — delete this guard and the file"
 
 
 # -- the acceptance gate -----------------------------------------------------
 
 
 def test_full_tree_clean_and_fast():
-    """`make lint` semantics: the v2 engine (file + project rules)
-    over the whole package is clean at HEAD and completes well under
-    the 10s budget."""
+    """`make lint` semantics: the v2 engine (file + project rules,
+    C parser included via native-abi-contract) over the whole package
+    yields nothing beyond the committed hot-path-cost ratchet and
+    completes well under the 10s budget."""
     t0 = time.monotonic()
     findings, n_files = analyze_paths([str(REPO_ROOT / "ratelimit_tpu")])
     elapsed = time.monotonic() - t0
-    assert findings == [], [f.text() for f in findings]
+    fresh = new_findings(findings, load_baseline())
+    assert fresh == [], [f.text() for f in fresh]
+    # The ratchet covers exactly the hot-path backlog: any baselined
+    # finding under another rule would hide a real regression.
+    assert {f.rule_id for f in findings} <= {"hot-path-cost"}
+    # No dead entries either — a fixed finding must leave the file,
+    # keeping the ratchet monotone (shrink-only).
+    assert len(findings) == len(load_baseline()["findings"])
     assert n_files > 60
     assert elapsed < 10.0, f"analyzer took {elapsed:.1f}s"
 
@@ -331,4 +347,31 @@ def test_bounded_wait_true_negatives_and_suppression():
     """Timed waits, background-thread idle blocks, off-path joins and
     the justified suppression all stay clean."""
     findings = by_rule(project_findings("boundedwait_ok"), "bounded-wait")
+    assert findings == [], [f.text() for f in findings]
+
+
+# -- hot-path-cost -----------------------------------------------------------
+
+
+def test_hot_path_cost_cross_module_true_positives():
+    """Allocation hazards fire both in the root itself and in a
+    backend reached through a typed attribute (`self.backend`)."""
+    findings = by_rule(project_findings("hotpath"), "hot-path-cost")
+    assert len(findings) == 5, [f.text() for f in findings]
+    messages = " | ".join(f.message for f in findings)
+    assert "lambda constructed per call" in messages
+    assert "nested function `tag`" in messages
+    assert "f-string built per iteration" in messages
+    assert "list comprehension allocated per iteration" in messages
+    assert "`self.cfg.scale` is loaded 3x" in messages
+    # Every finding names the request-path root it is reachable from.
+    assert all("reachable from" in f.message for f in findings)
+    by_file = {f.path.split("/")[-1] for f in findings}
+    assert by_file == {"service.py", "backend.py"}
+
+
+def test_hot_path_cost_true_negatives_and_suppression():
+    """Hazards off the request path, f-strings outside loops, and a
+    justified line suppression all stay clean."""
+    findings = by_rule(project_findings("hotpath_ok"), "hot-path-cost")
     assert findings == [], [f.text() for f in findings]
