@@ -48,6 +48,17 @@ FLIGHT_RECORDER_SIZE=0 — split into the backend note branch (the
 _prepare_resolved leg) and the handler-side record+observe stamp, and
 verifies decisions are identical with the recorder on vs off.  Writes
 benchmarks/results/flight_overhead.json.
+
+Event journal + correlation mode:
+      JAX_PLATFORMS=cpu python benchmarks/profile_host_path.py --events
+measures the fleet-observability additions against the acceptance
+budget — <= ~0.5us/request with the journal attached and the corr-id
+path enabled, ~0 disabled — split into the serving front half with the
+journal attached (which must be FREE: events stamp lifecycle
+transitions, never requests), the per-request corr-id leg of the gRPC
+handler (mint/parse + ring note), and the per-transition emit cost,
+and verifies decisions are identical with the plane on vs off.  Writes
+benchmarks/results/events_overhead.json.
 """
 
 from __future__ import annotations
@@ -552,6 +563,214 @@ def profile_flight():
     return results
 
 
+def profile_events():
+    """Per-request cost of the fleet-observability plane
+    (observability/events.py + the corr-id leg of flight.py), against
+    the acceptance budget — <= ~0.5us/request with the journal attached
+    and FLIGHT_CORR_ENABLED, ~0 with both off — plus decision parity.
+
+    Legs:
+
+    - ``front``:  the serving front half with the journal attached to
+                  the cache vs not.  The journal has ZERO hot-path
+                  branches (events stamp lifecycle transitions, never
+                  requests), so this must measure ~0 — the leg exists
+                  to keep that claim a number, not a comment;
+    - ``corr``:   the per-request corr-id work the gRPC handler does
+                  when FLIGHT_CORR_ENABLED — parse the inbound hex id
+                  (or mint one proxy-side), stamp it into the flight
+                  ring's thread-local note — vs the disabled guard;
+    - ``emit``:   the per-TRANSITION emit cost (ring store + tally),
+                  for scale: transitions are rare, so this never rides
+                  a request;
+    - ``parity``: do_limit_resolved decisions field-identical with the
+                  plane on vs off.
+    """
+    from ratelimit_tpu.api import Descriptor, RateLimitRequest  # noqa: E402
+    from ratelimit_tpu.backends.tpu_cache import TpuRateLimitCache  # noqa: E402
+    from ratelimit_tpu.observability import (  # noqa: E402
+        make_flight_recorder,
+        mint_corr,
+        parse_corr,
+    )
+    from ratelimit_tpu.observability.events import EventJournal  # noqa: E402
+    from ratelimit_tpu.service import RateLimitService  # noqa: E402
+    from ratelimit_tpu.stats.manager import Manager  # noqa: E402
+    from ratelimit_tpu.utils.time import PinnedTimeSource  # noqa: E402
+
+    n_reqs = 256
+    reps = 12
+    yaml = (
+        "domain: domain\n"
+        "descriptors:\n"
+        "  - key: key\n"
+        "    rate_limit:\n"
+        "      unit: hour\n"
+        "      requests_per_unit: 1000\n"
+    )
+
+    class _Runtime:
+        def __init__(self, files):
+            self._files = files
+
+        def snapshot(self):
+            files = self._files
+
+            class Snap:
+                def keys(self):
+                    return sorted(files)
+
+                def get(self, key):
+                    return files.get(key, "")
+
+            return Snap()
+
+        def add_update_callback(self, fn):
+            pass
+
+    def build(with_events):
+        clock = PinnedTimeSource(1_700_000_000)
+        engine = CounterEngine(num_slots=1 << 16)
+        cache = TpuRateLimitCache(engine, clock)
+        if with_events:
+            cache.events = EventJournal(size=1024)
+        svc = RateLimitService(
+            _Runtime({"config.bench": yaml}), cache, Manager(), clock=clock
+        )
+        return svc, cache
+
+    rng = np.random.default_rng(7)
+    key_ids = rng.integers(0, DUP_KEYS, n_reqs * 4)
+    reqs = []
+    for r in range(n_reqs):
+        descs = [
+            Descriptor.of(("key", f"value{key_ids[r * 4 + j]}"))
+            for j in range(4)
+        ]
+        reqs.append(RateLimitRequest("domain", descs, 0))
+
+    def front(svc, cache):
+        pool = cache._event_pool
+        config = svc.get_current_config()
+        for req in reqs:
+            items, *_ = cache._prepare_resolved(req, config)
+            if len(pool) < 1024:
+                for _bank, _eng, item in items:
+                    pool.append(item.event)
+
+    import gc
+
+    gc.collect()
+    results = {"requests": n_reqs, "descriptors_per_request": 4}
+
+    # Leg 1: front half with the journal attached vs not — interleaved
+    # best-of A/B (profile_flight's recipe) since the true delta is 0
+    # (the journal is never read on the serving path).  Alternate the
+    # A/B order each round so scheduler drift can't bias one side.
+    built = {"on": build(True), "off": build(False)}
+    for name, (svc, cache) in built.items():
+        front(svc, cache)  # warm the resolution cache
+    times = {"on": [], "off": []}
+    for i in range(8 * reps):
+        order = ("on", "off") if i % 2 == 0 else ("off", "on")
+        for name in order:
+            svc, cache = built[name]
+            t0 = time.perf_counter()
+            front(svc, cache)
+            times[name].append(time.perf_counter() - t0)
+    t_on, t_off = min(times["on"]), min(times["off"])
+    results["front_journal_off_us_per_req"] = t_off / n_reqs * 1e6
+    results["front_journal_on_us_per_req"] = t_on / n_reqs * 1e6
+    results["journal_overhead_us_per_req"] = (t_on - t_off) / n_reqs * 1e6
+
+    # Leg 2: the per-request corr-id leg, enabled vs the disabled
+    # guard — the exact shape of the gRPC handler's intake block
+    # (server/grpc_server.py): one inbound-header parse (replica) or
+    # mint (proxy), one thread-local ring note.
+    flight = make_flight_recorder(1 << 12)
+    inbound = "deadbeefcafef00d"
+
+    def corr_enabled():
+        note = flight.note_corr
+        for _req in reqs:
+            corr = parse_corr(inbound)
+            if corr == 0:
+                corr = mint_corr()
+            note(corr)
+
+    corr_off = False
+
+    def corr_disabled():
+        sink = 0
+        for _req in reqs:
+            if corr_off:
+                sink = mint_corr()
+        return sink
+
+    corr_enabled()
+    t_on = min(timed(corr_enabled, reps=reps)[0] for _ in range(3))
+    t_off = min(timed(corr_disabled, reps=reps)[0] for _ in range(3))
+    results["corr_enabled_us_per_req"] = t_on / n_reqs * 1e6
+    results["corr_disabled_us_per_req"] = t_off / n_reqs * 1e6
+    results["corr_overhead_us_per_req"] = (t_on - t_off) / n_reqs * 1e6
+    results["total_overhead_us_per_req"] = (
+        results["journal_overhead_us_per_req"]
+        + results["corr_overhead_us_per_req"]
+    )
+    results["budget_us_per_req"] = 0.5
+    results["within_budget"] = results["total_overhead_us_per_req"] <= 0.5
+
+    # Leg 3: per-transition emit cost, for scale (never per-request).
+    journal = EventJournal(size=4096)
+    n_emits = 4096
+
+    def emits():
+        emit = journal.emit
+        for i in range(n_emits):
+            emit("bank_quarantine", bank=0, kind="bench", role="lane")
+
+    emits()
+    t_emit, _ = timed(emits, reps=reps)
+    results["emit_us_per_event"] = t_emit / n_emits * 1e6
+
+    # Leg 4: decision parity with the plane attached.
+    svc_on, cache_on = built["on"]
+    svc_off, cache_off = built["off"]
+    cache_on.flight = make_flight_recorder(1 << 12)
+    identical = True
+    for req in reqs:
+        st_on, _l1, unl_on = cache_on.do_limit_resolved(
+            req, svc_on.get_current_config()
+        )
+        st_off, _l2, unl_off = cache_off.do_limit_resolved(
+            req, svc_off.get_current_config()
+        )
+        a = [
+            (s.code, s.limit_remaining, s.duration_until_reset)
+            for s in st_on
+        ]
+        b = [
+            (s.code, s.limit_remaining, s.duration_until_reset)
+            for s in st_off
+        ]
+        if a != b or unl_on != unl_off:
+            identical = False
+            break
+    results["decisions_identical_on_off"] = identical
+
+    path = os.path.join(
+        os.path.dirname(__file__), "results", "events_overhead.json"
+    )
+    with open(path, "w") as f:
+        json.dump(results, f, indent=2)
+    print(json.dumps(results, indent=2))
+    print(f"wrote {path}")
+    if not identical or not results["within_budget"]:
+        print("FAIL: events/corr overhead or parity budget violated")
+        sys.exit(1)
+    return results
+
+
 def profile_overload():
     """Per-request cost of the overload-control hot path
     (overload/controller.py), measured through the real serving seams
@@ -882,6 +1101,9 @@ def main():
         sys.exit(0)
     if "--overload" in sys.argv:
         profile_overload()
+        sys.exit(0)
+    if "--events" in sys.argv:
+        profile_events()
         sys.exit(0)
     if "--flight" in sys.argv:
         profile_flight()

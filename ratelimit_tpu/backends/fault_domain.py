@@ -141,6 +141,7 @@ class BankRecord:
         "backoff_s",
         "restarts",
         "fallback_decisions",
+        "fallback_evented",
     )
 
     def __init__(self, bank: int, role: str):
@@ -160,6 +161,9 @@ class BankRecord:
         self.backoff_s = 0.0
         self.restarts = 0
         self.fallback_decisions = 0
+        # One bank_fallback journal event per quarantine EPISODE (the
+        # per-decision count is a counter, not a timeline entry).
+        self.fallback_evented = False
 
 
 class DeviceFaultDomain:
@@ -230,6 +234,12 @@ class DeviceFaultDomain:
         self.stat_restarts = 0
         self.stat_probe_failures = 0
         self.stat_snapshots = 0
+        # Lifecycle event journal (observability/events.py), wired by
+        # the runner when EVENT_JOURNAL_SIZE > 0: quarantine entry,
+        # first fallback decision of an episode, half-open probes and
+        # restart outcomes land on the fleet timeline.  All emissions
+        # are transition-path only — never per request.
+        self.events = None
 
     # -- hot-path surface (backends/tpu_cache.py _execute) --------------
 
@@ -262,6 +272,16 @@ class DeviceFaultDomain:
         item.wait(5.0)
         rec.fallback_decisions += 1  # tpu-lint: disable=shared-state -- GIL-atomic stats counter, scrape-only reader
         self.stat_fallback_decisions += 1  # tpu-lint: disable=shared-state -- GIL-atomic stats counter, scrape-only reader
+        if self.events is not None and not rec.fallback_evented:
+            # First fallback decision of THIS quarantine episode: one
+            # timeline entry marking "traffic is now answered by the
+            # fallback" (per-decision volume stays in the counters).
+            # A racing second emitter is benign — two entries, not a
+            # wrong timeline.
+            rec.fallback_evented = True  # tpu-lint: disable=shared-state -- GIL-atomic episode flag; duplicate event is benign
+            self.events.emit(
+                "bank_fallback", bank=bank, mode=self.failure_mode
+            )
 
     # -- fault intake ----------------------------------------------------
 
@@ -300,6 +320,21 @@ class DeviceFaultDomain:
             rec.quarantined_at = now
             rec.backoff_s = self.restart_backoff_s
             rec.next_restart = now + rec.backoff_s
+            rec.fallback_evented = False  # new episode, new timeline entry
+            if self.events is not None:
+                # Stamp the episode marker BEFORE the state flip is
+                # visible: request threads emit bank_fallback the
+                # moment they observe "quarantined", and the timeline
+                # contract (docs/OBSERVABILITY.md) is quarantine ->
+                # fallback -> restart in seq/timestamp order.
+                self.events.emit(
+                    "bank_quarantine",
+                    bank=bank,
+                    role=rec.role,
+                    kind=kind,
+                    error=rec.fault_error,
+                    failure_mode=self.failure_mode,
+                )
             rec.state = "quarantined"
         d = self.cache._dispatchers.get(id(engine))
         if d is not None and d.dead is None:
@@ -458,17 +493,29 @@ class DeviceFaultDomain:
                 from .tpu_cache import warmup_engine
 
                 warmup_engine(new_engine)
-        except Exception:
+        except Exception as factory_exc:
             logger.exception(
                 "bank %d: engine factory failed; staying quarantined",
                 bank,
             )
             self._backoff(rec, now)
+            if self.events is not None:
+                self.events.emit(
+                    "bank_restart_failed",
+                    bank=bank,
+                    stage="factory",
+                    error=repr(factory_exc),
+                    next_attempt_in_s=round(rec.backoff_s, 3),
+                )
             return
         new_disp = self.cache._make_dispatcher(
             new_engine, name=f"tpu-dispatcher-restart{bank}-{rec.restarts}"
         )
         rec.state = "half_open"
+        if self.events is not None:
+            self.events.emit(
+                "bank_half_open", bank=bank, attempt=rec.restarts + 1
+            )
         ok = False
         try:
             ok = self._probe(bank, rec, new_engine, new_disp)
@@ -484,6 +531,13 @@ class DeviceFaultDomain:
                 bank,
                 rec.backoff_s,
             )
+            if self.events is not None:
+                self.events.emit(
+                    "bank_restart_failed",
+                    bank=bank,
+                    stage="probe",
+                    next_attempt_in_s=round(rec.backoff_s, 3),
+                )
             return
         # Probe passed: merge the mirror's counters and re-admit.  The
         # bank's fallback lock closes the window between export and
@@ -527,6 +581,10 @@ class DeviceFaultDomain:
             rec.role,
             rec.restarts,
         )
+        if self.events is not None:
+            self.events.emit(
+                "bank_restart", bank=bank, restarts=rec.restarts
+            )
 
     def _backoff(self, rec: BankRecord, now: float) -> None:
         rec.backoff_s = min(

@@ -108,6 +108,16 @@ def warmup_engine(engine) -> None:
             engine.step(batch)
 
 
+def _engine_failure(exc):
+    """Build the dead-engine CacheError OFF the _execute wait loop —
+    the f-string (and the deferred import) runs only when an RPC is
+    already failing, never per healthy iteration (tpu-lint
+    hot-path-cost)."""
+    from ..service import CacheError
+
+    return CacheError(f"counter engine failure: {exc}")
+
+
 class TpuRateLimitCache:
     def __init__(
         self,
@@ -180,6 +190,10 @@ class TpuRateLimitCache:
         if per_second_engine is not None:
             self._bank_labels.append("per_second")
         self._bank_labels.extend("algo_" + n for n in self._algo_order)
+        # Lazily-grown labels for bank indexes PAST the static table
+        # (override banks); _bank_label fills it on first sight so the
+        # format never runs inside the _execute submit loop.
+        self._extra_bank_labels = {}
         # Shadow-rollout divergence tallies per algorithm:
         # [agree, diverge] plain ints bumped on the RPC thread
         # (stats-only GIL races accepted, like the resolver tallies);
@@ -233,6 +247,12 @@ class TpuRateLimitCache:
         # the ring record after serialize.  None = disabled (the
         # per-request cost is one attribute load + branch).
         self.flight = None
+        # Lifecycle event journal (observability/events.py), attached
+        # by the runner when EVENT_JOURNAL_SIZE > 0: handoff
+        # export/import (cluster/handoff.py) and the fault domain's
+        # quarantine/restart transitions stamp the fleet timeline.
+        # Emission is transition-only — never per request.
+        self.events = None
         # Hot-key promotion cache (overload/controller.py), attached
         # by the runner when OVERLOAD_PROMOTE_ENABLED: stems the
         # sketch marked repeat offenders carry a short-TTL host-side
@@ -526,10 +546,16 @@ class TpuRateLimitCache:
                 is_unlimited[i] = True
                 continue  # limits[i] stays None (service contract)
             limits[i] = rule
+            # Hot-loop hoists (tpu-lint hot-path-cost): each of these
+            # rd.* chains is probed several times per descriptor below
+            # — load once per iteration instead of per use.
+            algo_id = rd.algo_id
+            algorithm = rd.algorithm
+            stem = rd.stem
             if fl_pending:
                 fl_pending = False
-                if rd.algo_id and not rd.algo_shadow:
-                    note_bank = self._algo_bank_index[rd.algorithm]
+                if algo_id and not rd.algo_shadow:
+                    note_bank = self._algo_bank_index[algorithm]
                 elif ps_bank is not None and rd.per_second:
                     note_bank = n_lanes
                 else:
@@ -538,7 +564,7 @@ class TpuRateLimitCache:
             if hk is not None:
                 e = rd.hot
                 if e is None or e.key is None:
-                    e = hk.track(rd.stem)
+                    e = hk.track(stem)
                     rd.hot = e
                 e.hits += hits_addend
                 hk_observed += hits_addend
@@ -556,7 +582,7 @@ class TpuRateLimitCache:
             if ws is None or ws.window != now - now % rd.divider:
                 ws = rd.window_state(now)
             key = keys[i] = ws.cache_key
-            if rd.algo_id and not rd.algo_shadow:
+            if algo_id and not rd.algo_shadow:
                 # Rule ENFORCES a non-default algorithm: route to its
                 # dedicated bank.  The host over-limit cache is skipped
                 # — these kernels refill capacity continuously, so a
@@ -564,17 +590,17 @@ class TpuRateLimitCache:
                 categories[i] = _CAT_ENGINE
                 if algo_accs is None:
                     algo_accs = {}
-                acc = algo_accs.get(rd.algorithm)
+                acc = algo_accs.get(algorithm)
                 if acc is None:
-                    acc = algo_accs[rd.algorithm] = ([], [], [])
+                    acc = algo_accs[algorithm] = ([], [], [])
                 acc[0].append(i)
                 acc[1].append(ws.algo_key_bytes)
                 acc[2].append(ws.algo_template_bytes)
                 continue
             if (
                 promo_entries is not None
-                and rd.stem in promo_entries
-                and promotion.contains(rd.stem)
+                and stem in promo_entries
+                and promotion.contains(stem)
             ):
                 # Hot-key promotion (overload/controller.py): the
                 # sketch marked this stem a repeat offender; serve the
@@ -589,7 +615,7 @@ class TpuRateLimitCache:
                 categories[i] = _CAT_SKIP if rule.shadow_mode else _CAT_LOCAL
                 continue
             categories[i] = _CAT_ENGINE
-            if rd.algo_id:
+            if algo_id:
                 # Shadow rollout: the candidate kernel evaluates the
                 # same descriptor on its own bank while fixed-window
                 # enforcement proceeds below; divergence is tallied
@@ -600,13 +626,13 @@ class TpuRateLimitCache:
                     raw_over = [False] * n
                     cand_over = [None] * n
                     cand_code = [None] * n
-                sa = shadow_accs.get(rd.algorithm)
+                sa = shadow_accs.get(algorithm)
                 if sa is None:
-                    sa = shadow_accs[rd.algorithm] = ([], [], [])
+                    sa = shadow_accs[algorithm] = ([], [], [])
                 sa[0].append(i)
                 sa[1].append(ws.algo_key_bytes)
                 sa[2].append(ws.algo_template_bytes)
-                shadow_rows.append((i, rd.algorithm, rd.algo_id))
+                shadow_rows.append((i, algorithm, algo_id))
             if single_bank:
                 add_row(i)
                 add_enc(ws.key_bytes)
@@ -922,6 +948,15 @@ class TpuRateLimitCache:
                     if rpu - st.limit_remaining > rpu * ratio:
                         e.near_limit += hits_addend
 
+    def _bank_label(self, bank: int) -> str:
+        """Trace label for a bank index past the static table (override
+        banks): format once, memoize, so the submit loop in _execute
+        never builds a string per iteration (tpu-lint hot-path-cost)."""
+        label = self._extra_bank_labels.get(bank)
+        if label is None:
+            label = self._extra_bank_labels[bank] = f"bank{bank}"  # tpu-lint: disable=shared-state -- GIL-atomic memo write; two threads formatting the same index is benign
+        return label
+
     def _execute(
         self,
         limits,
@@ -953,9 +988,13 @@ class TpuRateLimitCache:
         # the stamps to spans after wait() — see _record_item_spans.
         span = TRACER.current()
         labels = self._bank_labels
+        n_labels = len(labels)
         fd = self.fault_domain
         pending: List[tuple] = []  # (bank, engine, item) awaiting wait
         done: List[WorkItem] = []  # answered items (events recyclable)
+        # Hot-loop hoist (tpu-lint hot-path-cost): the bound method
+        # once, not one attribute probe per answered item.
+        done_append = done.append
         inline: List[tuple] = []
         # Submit all banks first, then wait: the banks' device steps
         # overlap (the reference likewise pipelines both Redis clients
@@ -963,8 +1002,13 @@ class TpuRateLimitCache:
         for bank, engine, item in prep_items:
             if span is not None:
                 item.trace = {
+                    # Banks past the static label table (override
+                    # banks) format their label in _bank_label — off
+                    # this loop body, and only on that rare leg.
                     "bank": (
-                        labels[bank] if bank < len(labels) else f"bank{bank}"
+                        labels[bank]
+                        if bank < n_labels
+                        else self._bank_label(bank)
                     ),
                     "submit": time.perf_counter(),
                 }
@@ -972,7 +1016,7 @@ class TpuRateLimitCache:
                 if fd.is_quarantined(bank):
                     fd.run_fallback(bank, item)
                     self._note_fallback()
-                    done.append(item)
+                    done_append(item)
                     continue
                 engine = fd.engine_at(bank)  # swap-safe resolve
             d = self._dispatchers.get(id(engine))
@@ -986,18 +1030,14 @@ class TpuRateLimitCache:
                     # Dead dispatcher: fail THIS rpc immediately (the
                     # reference's RedisError-on-dead-pool analog) —
                     # never burn the wait timeout.
-                    from ..service import CacheError
-
-                    raise CacheError(
-                        f"counter engine failure: {e}"
-                    ) from e
+                    raise _engine_failure(e) from e
                 from .fault_domain import classify_fault
 
                 fd.record_fault(bank, classify_fault(e), e)
                 clone = self._clone_item(item)
                 fd.run_fallback(bank, clone)
                 self._note_fallback()
-                done.append(clone)
+                done_append(clone)
                 continue
             pending.append((bank, engine, item))
         for bank, engine, item in inline:
@@ -1030,7 +1070,7 @@ class TpuRateLimitCache:
                     # it may be healthy, just slower than this RPC can
                     # wait (mirrors the cluster retry discipline,
                     # test_retry_never_sleeps_past_caller_deadline).
-                    done.append(self._answer_failure_mode(item))
+                    done_append(self._answer_failure_mode(item))
                     continue
                 if fd is not None:
                     from .fault_domain import FAULT_HANG
@@ -1039,11 +1079,9 @@ class TpuRateLimitCache:
                     clone = self._clone_item(item)
                     fd.run_fallback(bank, clone)
                     self._note_fallback()
-                    done.append(clone)
+                    done_append(clone)
                     continue
-                from ..service import CacheError
-
-                raise CacheError(f"counter engine failure: {e}") from e
+                raise _engine_failure(e) from e
             except Exception as e:
                 if fd is not None:
                     from .fault_domain import classify_fault
@@ -1052,12 +1090,10 @@ class TpuRateLimitCache:
                     clone = self._clone_item(item)
                     fd.run_fallback(bank, clone)
                     self._note_fallback()
-                    done.append(clone)
+                    done_append(clone)
                     continue
-                from ..service import CacheError
-
-                raise CacheError(f"counter engine failure: {e}") from e
-            done.append(item)
+                raise _engine_failure(e) from e
+            done_append(item)
         # All answered items' events are settled: the completers' (or
         # fallback path's) set() calls happened-before here and
         # nothing touches these events again, so they are safe to

@@ -181,6 +181,11 @@ def export_from_cache(
                 "at": time.time(),
             }
         )
+    events = getattr(cache, "events", None)
+    if events is not None:
+        # The replica's half of the handoff timeline (the proxy journal
+        # carries begin/end; this replica's journal shows what LEFT it).
+        events.emit("handoff_export", keys=total, sections=len(sections))
     logger.warning(
         "handoff export: %d keys across %d banks leave %s",
         total,
@@ -249,6 +254,9 @@ def import_into_cache(cache, sections: List[dict], now: Optional[int] = None) ->
     log = getattr(cache, "handoff_log", None)
     if log is not None:
         log.note_import({**totals, "at": time.time()})
+    events = getattr(cache, "events", None)
+    if events is not None:
+        events.emit("handoff_import", **totals)
     logger.warning("handoff import: %s", totals)
     return totals
 

@@ -58,19 +58,23 @@ def grpc_transport(
         request_serializer=rls_pb2.RateLimitRequest.SerializeToString,
         response_deserializer=rls_pb2.RateLimitResponse.FromString,
     )
-    metadata = (
-        (("authorization", f"Bearer {auth_token}"),) if auth_token else None
+    static_md = (
+        (("authorization", f"Bearer {auth_token}"),) if auth_token else ()
     )
 
     def call(
-        request: rls_pb2.RateLimitRequest, timeout_s=None
+        request: rls_pb2.RateLimitRequest, timeout_s=None, metadata=None
     ) -> rls_pb2.RateLimitResponse:
         t = (
             max_subcall_s
             if timeout_s is None
             else min(max_subcall_s, timeout_s)
         )
-        return method(request, timeout=t, metadata=metadata)
+        # Per-call pairs (traceparent, x-ratelimit-corr — the
+        # cross-hop observability carry) ride next to the static
+        # bearer metadata; None when neither side has any.
+        md = static_md + tuple(metadata) if metadata else (static_md or None)
+        return method(request, timeout=t, metadata=md)
 
     return call
 
@@ -106,13 +110,17 @@ def build_router(
     auth_token: str = "",
     retry_max: int = 0,
     retry_base_s: float = 0.05,
+    flight=None,
+    events=None,
 ) -> ReplicaRouter:
     """`channel_credentials` (replica_channel_credentials) switches
     the replica channels to TLS/mTLS; `auth_token` adds bearer
     metadata to every sub-call.  Defaults stay plaintext.
     `retry_max`/`retry_base_s`: same-owner retry budget for transient
     failures (exponential backoff + jitter, deadline-bounded — see
-    ReplicaRouter)."""
+    ReplicaRouter).  `flight`/`events` are the proxy's observability
+    plane (flight ring + lifecycle journal) — they OUTLIVE any one
+    router, so membership swaps keep one continuous timeline."""
     if channel_credentials is not None:
         channels = [
             grpc.secure_channel(a, channel_credentials)
@@ -131,6 +139,8 @@ def build_router(
         transport_ceiling_s=max_subcall_s,
         retry_max=retry_max,
         retry_base_s=retry_base_s,
+        flight=flight,
+        events=events,
     )
 
 
@@ -157,10 +167,15 @@ class RouterHolder:
     membership churn).
     """
 
-    def __init__(self, router: ReplicaRouter, handoff=None):
+    def __init__(self, router: ReplicaRouter, handoff=None, events=None):
         self._router = router
         self._handoff = handoff
+        self.events = events
         self.last_handoff: Optional[dict] = None
+        # Monotonic stamp of the last handoff COMPLETION — /stats.json
+        # renders its age so a runbook reader sees "how stale is the
+        # last counter transfer" without parsing the summary dict.
+        self._last_handoff_mono: Optional[float] = None
 
     @property
     def replica_ids(self) -> List[str]:
@@ -175,18 +190,37 @@ class RouterHolder:
         out = self._router.stats()
         if self.last_handoff is not None:
             out["last_handoff"] = self.last_handoff
+        if self._last_handoff_mono is not None:
+            out["last_handoff_age_s"] = round(
+                time.monotonic() - self._last_handoff_mono, 3
+            )
         return out
 
-    def should_rate_limit(self, request, timeout_s=None):
-        return self._router.should_rate_limit(request, timeout_s=timeout_s)
+    def should_rate_limit(self, request, timeout_s=None, metadata=None):
+        return self._router.should_rate_limit(
+            request, timeout_s=timeout_s, metadata=metadata
+        )
 
     def swap(self, new_router: ReplicaRouter, grace_s: float = 30.0) -> None:
         old_ids = list(self._router.replica_ids)
+        new_ids = list(new_router.replica_ids)
+        if self.events is not None:
+            self.events.emit(
+                "membership_change",
+                old=old_ids,
+                new=new_ids,
+                added=sorted(set(new_ids) - set(old_ids)),
+                removed=sorted(set(old_ids) - set(new_ids)),
+            )
         if self._handoff is not None:
             # Arm the forwarding window BEFORE the new router serves:
             # a moved key's first post-swap request must still land on
             # its old owner or its counter forks.
             new_router.begin_forwarding(old_ids)
+            if self.events is not None:
+                self.events.emit(
+                    "handoff_begin", old=old_ids, new=new_ids
+                )
         old, self._router = self._router, new_router
         if self._handoff is not None:
             t = threading.Thread(
@@ -201,19 +235,42 @@ class RouterHolder:
         t2.start()
 
     def _run_handoff(self, old_ids: List[str], new_router: ReplicaRouter):
+        summary = None
         try:
-            self.last_handoff = self._handoff(
-                old_ids, list(new_router.replica_ids)
-            )
-        except Exception:
+            summary = self._handoff(old_ids, list(new_router.replica_ids))
+            self.last_handoff = summary
+            self._last_handoff_mono = time.monotonic()
+        except Exception as e:
             logger.exception(
                 "membership handoff failed; moved keys restart their "
                 "windows (pre-handoff amnesia envelope)"
             )
+            if self.events is not None:
+                self.events.emit("handoff_partition", error=repr(e))
         finally:
             # Whatever happened, stop forwarding: the new owners are
             # authoritative from here (with or without history).
             new_router.end_forwarding()
+            if self.events is not None:
+                self.events.emit(
+                    "handoff_end",
+                    ok=summary is not None,
+                    **(
+                        {
+                            k: summary[k]
+                            for k in (
+                                "moved_keys",
+                                "imported",
+                                "merged",
+                                "dropped",
+                                "duration_s",
+                            )
+                            if k in summary
+                        }
+                        if isinstance(summary, dict)
+                        else {}
+                    ),
+                )
 
     def close(self) -> None:
         self._router.close()
@@ -400,11 +457,27 @@ def watch_replicas_srv(
     return t, stop
 
 
-def start_debug_server(holder, host: str, port: int):
+def start_debug_server(
+    holder,
+    host: str,
+    port: int,
+    admin_urls: Optional[dict] = None,
+    events=None,
+    flight=None,
+    fleet_timeout_s: float = 2.0,
+):
     """Optional HTTP observability for the proxy (the replicas'
     debug-port analog): /stats.json returns the router's failover
     counters + live membership; /healthcheck mirrors the gRPC health
-    probe (200 while any replica is live, 500 otherwise)."""
+    probe (200 while any replica is live, 500 otherwise).
+
+    `admin_urls` (the --replica-admin map) additionally opens
+    /fleet.json — the aggregated fleet view (cluster/fleet.py) that
+    scrapes every replica's debug surfaces with bounded deadlines and
+    merges them; `events` (an EventJournal) opens /debug/events (the
+    proxy's lifecycle timeline, since= cursor like the replicas');
+    `flight` opens /debug/flight (the proxy-side ring — route
+    decisions, corr ids, latency buckets)."""
     import json as _json
 
     from ..server.http_server import HttpServer
@@ -433,13 +506,76 @@ def start_debug_server(holder, host: str, port: int):
     # last handoff summary).
     srv.add_route("GET", "/debug/cluster", stats_json)
     srv.add_route("GET", "/healthcheck", healthcheck)
+
+    if events is not None:
+        from urllib.parse import parse_qs, urlsplit
+
+        def events_view(h):
+            qs = parse_qs(urlsplit(h.path).query)
+            try:
+                since = int(qs.get("since", ["0"])[0])
+            except ValueError:
+                h._reply(400, b"bad since= cursor (want an integer)\n")
+                return
+            h._reply(
+                200,
+                _json.dumps(
+                    {
+                        "emitted": events.emitted,
+                        "counts": events.counts(),
+                        "events": events.snapshot(since=since),
+                    }
+                ).encode(),
+                content_type="application/json",
+            )
+
+        srv.add_route("GET", "/debug/events", events_view)
+
+    if flight is not None:
+
+        def flight_view(h):
+            # Proxy half of the cross-hop join: same record schema as
+            # the replicas' /debug/flight (newest first), corr ids in
+            # hex16.  The ring is opt-in (--flight-recorder-size), so
+            # no extra gate here — the listener itself is management-
+            # interface-only (see --debug-port help).
+            h._reply(
+                200,
+                _json.dumps(
+                    {
+                        "capacity": flight.size,
+                        "records": flight.snapshot_dicts(),
+                    }
+                ).encode(),
+                content_type="application/json",
+            )
+
+        srv.add_route("GET", "/debug/flight", flight_view)
+
+    if admin_urls:
+        from .fleet import FleetAggregator
+
+        agg = FleetAggregator(
+            admin_urls, timeout_s=fleet_timeout_s, events=events
+        )
+
+        def fleet_view(h):
+            h._reply(
+                200,
+                _json.dumps(agg.fleet(holder)).encode(),
+                content_type="application/json",
+            )
+
+        srv.add_route("GET", "/fleet.json", fleet_view)
+
     srv.start()
     logger.warning("proxy debug listener on :%d", srv.bound_port)
     return srv
 
 
 def make_server(
-    router: ReplicaRouter, host: str, port: int, credentials=None
+    router: ReplicaRouter, host: str, port: int, credentials=None,
+    flight=None,
 ):
     """Build the proxy's gRPC server; returns (server, bound_port) —
     port 0 selects an ephemeral port (tests).  Serves the standard
@@ -449,7 +585,27 @@ def make_server(
     thing that CAN fail from here: replica reachability — when every
     replica's circuit is open the probe answers NOT_SERVING so a
     balancer can drain a partition-blind proxy (r3 verdict weak #5);
-    any live replica answers SERVING."""
+    any live replica answers SERVING.
+
+    `flight` (an observability FlightRecorder, --flight-recorder-size)
+    turns on the proxy's half of cross-hop correlation: each request
+    mints a 63-bit corr id, stamps it into the proxy ring record
+    (route decision + latency bucket; the router deposits the chosen
+    replica in the stem/lane fields) and carries it to the owner
+    replica in gRPC metadata (x-ratelimit-corr), where it lands in the
+    replica's ring and trace spans — one grep joins the hop-by-hop
+    story.  None (the default) keeps the historical zero-cost path:
+    no mint, no metadata pair, no stamp."""
+    from ..observability.flight import (  # noqa: PLC0415
+        CORR_HEADER,
+        format_corr,
+        mint_corr,
+    )
+    from ..observability.trace import (  # noqa: PLC0415
+        TRACEPARENT_HEADER,
+        TRACER,
+    )
+
     def should_rate_limit(request_pb, context):
         remaining = context.time_remaining()
         if remaining is not None and remaining <= 0:
@@ -457,18 +613,67 @@ def make_server(
             context.abort(
                 grpc.StatusCode.DEADLINE_EXCEEDED, "client deadline expired"
             )
-        try:
-            # Propagate the caller's remaining deadline to replica
-            # sub-calls (time_remaining() is None without a deadline).
-            return router.should_rate_limit(
-                request_pb, timeout_s=remaining
-            )
-        except DeadlineExceededError as e:
-            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
-        except grpc.RpcError as e:
-            # Propagate the replica's status (e.g. INVALID_ARGUMENT on
-            # empty domain) instead of wrapping it in UNKNOWN.
-            context.abort(e.code(), e.details())
+        tp_in = None
+        if TRACER.enabled:
+            for k, v in context.invocation_metadata():
+                if k == TRACEPARENT_HEADER:
+                    tp_in = v
+                    break
+        root = TRACER.start_span("proxy.should_rate_limit", tp_in)
+        corr = 0
+        md = None
+        if flight is not None:
+            corr = mint_corr()
+            # Sticky intake stamp (observability/flight.py _Note.corr):
+            # the forwarded/degraded sentinel records the router stamps
+            # on this thread share the id with the post-merge record
+            # below, and a pooled handler thread can never bleed a
+            # previous request's id.
+            flight.note_corr(corr)
+            md = [(CORR_HEADER, format_corr(corr))]
+        # Continue the trace downstream only when someone chose this
+        # request — the caller sent a traceparent or our own head
+        # sampling said yes.  (NOT on the always-on error-capture span:
+        # that would attach metadata to every sub-call in the default
+        # config, a per-request cost and a surprise to bare transports.)
+        if root.recording and (tp_in is not None or root.sampled):
+            md = (md or []) + [(TRACEPARENT_HEADER, root.traceparent())]
+        start = time.perf_counter()
+        with root:
+            try:
+                # Propagate the caller's remaining deadline to replica
+                # sub-calls (time_remaining() is None w/o a deadline).
+                response = router.should_rate_limit(
+                    request_pb, timeout_s=remaining, metadata=md
+                )
+            except DeadlineExceededError as e:
+                root.set_status("error", str(e))
+                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+            except grpc.RpcError as e:
+                # Propagate the replica's status (e.g. INVALID_ARGUMENT
+                # on empty domain) instead of wrapping it in UNKNOWN.
+                root.set_status("error", str(e.details()))
+                context.abort(e.code(), e.details())
+            root.set_attr("domain", request_pb.domain)
+            root.set_attr("descriptors", len(request_pb.descriptors))
+            if corr:
+                root.set_attr("corr", format_corr(corr))
+            if (
+                response.overall_code
+                == rls_pb2.RateLimitResponse.OVER_LIMIT
+            ):
+                root.set_status("over_limit")
+            if flight is not None:
+                # The proxy-side ring record: overall decision, route
+                # (stem/lane = crc32(chosen replica)/owner index, from
+                # the router's note), latency bucket, corr id.
+                flight.record(
+                    request_pb.domain,
+                    int(response.overall_code),
+                    request_pb.hits_addend,
+                    (time.perf_counter() - start) * 1000.0,
+                )
+            return response
 
     handler = grpc.method_handlers_generic_handler(
         RATELIMIT_SERVICE,
@@ -652,6 +857,38 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "or not (bounds worker-thread pinning on a blackholed replica)",
     )
     p.add_argument(
+        "--flight-recorder-size", type=int, default=0,
+        help="proxy-side decision flight ring (observability/flight.py): "
+        "each request mints a correlation id, stamps the route decision "
+        "+ latency bucket here, and carries the id to the owner replica "
+        "in gRPC metadata so one id joins the proxy ring, the replica "
+        "ring and the replica's trace spans; served at /debug/flight on "
+        "--debug-port.  0 (default) disables — no mint, no metadata "
+        "pair, no per-request cost",
+    )
+    p.add_argument(
+        "--event-journal-size", type=int, default=1024,
+        help="lifecycle event journal ring (observability/events.py): "
+        "membership changes, handoff begin/end, replica ejection and "
+        "readmission land here, served at /debug/events and merged "
+        "into /fleet.json; emission is transition-only (zero "
+        "per-request cost).  0 disables",
+    )
+    p.add_argument(
+        "--fleet-timeout-seconds", type=float, default=2.0,
+        help="per-endpoint deadline for the /fleet.json replica "
+        "scrapes (each replica costs at most 6x this; circuit-open "
+        "replicas are skipped outright)",
+    )
+    p.add_argument(
+        "--trace-sample-rate", type=float, default=0.0,
+        help="head-sampling rate for the proxy's own request spans "
+        "(observability/trace.py; error/over-limit tails always "
+        "commit).  An inbound sampled traceparent forces the decision "
+        "regardless, and the proxy continues the caller's trace id "
+        "downstream either way",
+    )
+    p.add_argument(
         "--replica-tls-ca", default="",
         help="PEM CA verifying replica server certs; enables TLS on "
         "proxy->replica channels (Redis TLS analog, settings.go:62-74)",
@@ -701,6 +938,18 @@ def main(argv=None) -> None:
             args.replica_tls_ca, args.replica_tls_cert, args.replica_tls_key
         )
 
+    # The observability plane (flight ring, lifecycle journal, span
+    # sampling) lives OUTSIDE the routers: membership swaps replace the
+    # router but the timeline and the ring stay continuous.
+    from ..observability.events import make_event_journal
+    from ..observability.flight import make_flight_recorder
+    from ..observability.trace import TRACER
+
+    flight = make_flight_recorder(args.flight_recorder_size)
+    journal = make_event_journal(args.event_journal_size)
+    if args.trace_sample_rate:
+        TRACER.configure(sample_rate=args.trace_sample_rate)
+
     def build(addrs_):
         return build_router(
             addrs_,
@@ -712,9 +961,12 @@ def main(argv=None) -> None:
             auth_token=args.auth_token,
             retry_max=args.retry_max,
             retry_base_s=args.retry_base_seconds,
+            flight=flight,
+            events=journal,
         )
 
     handoff = None
+    admin_urls = None
     if args.replica_admin:
         from .handoff import (
             HandoffCoordinator,
@@ -739,7 +991,7 @@ def main(argv=None) -> None:
         )
     else:
         addrs = [a.strip() for a in args.replicas.split(",") if a.strip()]
-    holder = RouterHolder(build(addrs), handoff=handoff)
+    holder = RouterHolder(build(addrs), handoff=handoff, events=journal)
     if args.replicas_file:
         watch_replicas_file(
             holder, args.replicas_file, args.poll_seconds, build=build
@@ -756,12 +1008,20 @@ def main(argv=None) -> None:
         from ..server.grpc_server import server_credentials
 
         own_creds = server_credentials(args.tls_cert, args.tls_key)
-    server, bound = make_server(holder, args.host, args.port, own_creds)
+    server, bound = make_server(
+        holder, args.host, args.port, own_creds, flight=flight
+    )
     server.start()
     debug_server = None
     if args.debug_port:
         debug_server = start_debug_server(
-            holder, args.debug_host, args.debug_port
+            holder,
+            args.debug_host,
+            args.debug_port,
+            admin_urls=admin_urls,
+            events=journal,
+            flight=flight,
+            fleet_timeout_s=args.fleet_timeout_seconds,
         )
     logger.warning(
         "cluster proxy serving :%d over %d replicas", bound, len(addrs)
@@ -788,6 +1048,8 @@ def main(argv=None) -> None:
     if debug_server is not None:
         debug_server.stop()
     holder.close()
+    if journal is not None:
+        journal.close()
 
 
 if __name__ == "__main__":

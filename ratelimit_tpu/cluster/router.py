@@ -44,6 +44,7 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 import time
 from typing import Dict, List, Optional, Protocol, Sequence
+from zlib import crc32 as _crc32
 
 from ..server import pb  # noqa: F401  (sys.path for generated protos)
 
@@ -158,7 +159,9 @@ class _Circuit:
                success closes the circuit, failure re-arms it.
     """
 
-    __slots__ = ("failures", "is_open", "retry_at", "probe_until")
+    __slots__ = (
+        "failures", "is_open", "retry_at", "probe_until", "opened_at"
+    )
 
     def __init__(self):
         self.failures = 0
@@ -167,6 +170,10 @@ class _Circuit:
         # While now < probe_until, one request holds the half-open
         # probe claim; concurrent requests route around the replica.
         self.probe_until = 0.0
+        # Monotonic stamp of the ejection that opened this circuit
+        # (0.0 while closed) — /stats.json renders it as open_since_s
+        # so an operator can tell a fresh trip from an hour-old outage.
+        self.opened_at = 0.0
 
 
 # Proto RateLimit.Unit -> seconds (the wire enum, not api.Unit): the
@@ -234,6 +241,13 @@ class Transport(Protocol):
         timeout_s: Optional[float] = None,
     ) -> rls_pb2.RateLimitResponse: ...
 
+    # Transports MAY additionally accept a keyword-only
+    # ``metadata=Sequence[Tuple[str, str]]`` (extra gRPC metadata for
+    # this call: the proxy's traceparent + correlation id).  The
+    # router only passes the keyword when the caller supplied
+    # metadata, so minimal test fakes with the two-argument signature
+    # above keep working unchanged.
+
 
 class ReplicaRouter:
     """Fan descriptors out to their owning replicas; merge responses.
@@ -268,6 +282,7 @@ class ReplicaRouter:
         rng: Optional[random.Random] = None,
         sleep=time.sleep,
         flight=None,
+        events=None,
     ):
         """`eject_after`: consecutive replica-health failures before a
         replica's circuit opens and its keys re-own to the survivors
@@ -286,7 +301,9 @@ class ReplicaRouter:
         caller's remaining absolute deadline.  0 keeps the historical
         fail-straight-to-failover behavior.  `rng`/`sleep` are test
         seams.  `flight` (an observability FlightRecorder) stamps
-        degraded-mode and forwarded decisions when provided."""
+        degraded-mode and forwarded decisions when provided.
+        `events` (an observability EventJournal) records ejection and
+        readmission transitions on the fleet timeline."""
         if len(replica_ids) != len(transports):
             raise ValueError("replica_ids and transports length mismatch")
         if not replica_ids:
@@ -315,6 +332,7 @@ class ReplicaRouter:
         self._rng = rng or random.Random()
         self._sleep = sleep
         self.flight = flight
+        self.events = events
         self._fc_degraded = self._fc_forwarded = 0
         if flight is not None:
             from ..observability.flight import (
@@ -382,6 +400,10 @@ class ReplicaRouter:
                         else ("half-open" if c.is_open else "closed")
                     ),
                     "consecutive_failures": c.failures,
+                    # Age of the current outage; null while closed.
+                    "open_since_s": (
+                        round(now - c.opened_at, 3) if c.is_open else None
+                    ),
                 }
                 for rid, c in zip(self.replica_ids, self._circuits)
             ]
@@ -491,6 +513,7 @@ class ReplicaRouter:
             )
             if newly_open:
                 c.is_open = True
+                c.opened_at = time.monotonic()
                 self.stat_ejections += 1
             c.probe_until = 0.0  # the probe call itself just finished
             if c.is_open:
@@ -505,6 +528,13 @@ class ReplicaRouter:
                 self._circuits[idx].failures,
                 exc,
             )
+            if self.events is not None:
+                self.events.emit(
+                    "replica_eject",
+                    replica=self.replica_ids[idx],
+                    failures=self._circuits[idx].failures,
+                    error=repr(exc),
+                )
 
     def _record_success(self, idx: int) -> None:
         with self._health_lock:
@@ -513,6 +543,7 @@ class ReplicaRouter:
             c.failures = 0
             c.is_open = False
             c.probe_until = 0.0
+            c.opened_at = 0.0
             if was_open:
                 self.stat_readmissions += 1
         if was_open:
@@ -520,14 +551,21 @@ class ReplicaRouter:
                 "replica %s recovered; re-admitted to the rendezvous set",
                 self.replica_ids[idx],
             )
+            if self.events is not None:
+                self.events.emit(
+                    "replica_readmit", replica=self.replica_ids[idx]
+                )
 
-    def _checked_call(self, idx: int, sub_request, remaining):
+    def _checked_call(self, idx: int, sub_request, remaining, md=None):
         """One transport call with circuit bookkeeping.  Replica-health
         errors raise _ReplicaCallError (drives failover); application
         statuses and caller-deadline expiry propagate unchanged.
         Every exit releases any probe claim on `idx` (success/failure
         release via the recorders; the propagate paths release
-        explicitly) so an aborted probe can't block readmission."""
+        explicitly) so an aborted probe can't block readmission.
+        `md` is opaque per-call metadata (traceparent + correlation
+        id); it is only passed to transports when non-None — see the
+        Transport protocol note."""
         try:
             budget = remaining()
         except DeadlineExceededError:
@@ -543,7 +581,12 @@ class ReplicaRouter:
             else min(budget, self.transport_ceiling_s)
         )
         try:
-            resp = self.transports[idx](sub_request, timeout_s=budget)
+            t = self.transports[idx]
+            resp = (
+                t(sub_request, timeout_s=budget)
+                if md is None
+                else t(sub_request, timeout_s=budget, metadata=md)
+            )
         except DeadlineExceededError:
             self._release_probes([idx])
             raise
@@ -559,7 +602,7 @@ class ReplicaRouter:
         self._record_success(idx)
         return resp
 
-    def _call_retrying(self, idx: int, sub_request, remaining):
+    def _call_retrying(self, idx: int, sub_request, remaining, md=None):
         """_checked_call plus bounded same-owner retries on transient
         replica failures: exponential backoff with jitter, stopping
         early when the replica's circuit opened meanwhile (failover
@@ -570,7 +613,7 @@ class ReplicaRouter:
         attempt = 0
         while True:
             try:
-                return self._checked_call(idx, sub_request, remaining)
+                return self._checked_call(idx, sub_request, remaining, md)
             except _ReplicaCallError:
                 if attempt >= self.retry_max:
                     raise
@@ -605,7 +648,7 @@ class ReplicaRouter:
         return sub
 
     def _route_and_call(
-        self, request, rows, cand: List[int], claimed, remaining
+        self, request, rows, cand: List[int], claimed, remaining, md=None
     ):
         """Group descriptor indices `rows` by rendezvous owner over the
         candidate set, release probe claims this request routes nothing
@@ -655,13 +698,22 @@ class ReplicaRouter:
             try:
                 return (
                     sub_rows,
-                    self._call_retrying(owner, sub, remaining),
+                    self._call_retrying(owner, sub, remaining, md),
                     None,
                 )
             except _ReplicaCallError as e:
                 return sub_rows, None, e
 
         owners = list(by_owner.items())
+        if self.flight is not None and owners:
+            # Proxy-side flight note: the primary route decision for
+            # this request — (crc32 of the chosen replica id, owner
+            # index) land in the stem/lane fields of the record the
+            # proxy handler stamps after the merge.  Deposited on the
+            # request thread (owners[0] runs inline below), so the
+            # thread-local note pairs with the right record.
+            rid = self.replica_ids[owners[0][0]]
+            self.flight.note(_crc32(rid.encode("utf-8")), owners[0][0])
         futures = []
         inline_extra = []
         for owner, sub_rows in owners[1:]:
@@ -733,6 +785,7 @@ class ReplicaRouter:
         self,
         request: rls_pb2.RateLimitRequest,
         timeout_s: Optional[float] = None,
+        metadata=None,
     ) -> rls_pb2.RateLimitResponse:
         # Absolute deadline: every sub-call gets the budget REMAINING
         # when it starts (pool queueing eats from the same budget).
@@ -804,7 +857,7 @@ class ReplicaRouter:
                     untouched.discard(idx)
                     try:
                         return self._checked_call(
-                            idx, request, probe_remaining
+                            idx, request, probe_remaining, metadata
                         )
                     except _ReplicaCallError:
                         continue
@@ -833,7 +886,7 @@ class ReplicaRouter:
                 self._release_probes(untouched)
 
         outcome = self._route_and_call(
-            request, range(n), cand, claimed, remaining
+            request, range(n), cand, claimed, remaining, metadata
         )
 
         # Failover pass (sentinel analog): descriptors whose owner
@@ -858,7 +911,12 @@ class ReplicaRouter:
                 fallback_rows.extend(failed_rows)
             else:
                 retries = self._route_and_call(
-                    request, failed_rows, retry_set, retry_claimed, remaining
+                    request,
+                    failed_rows,
+                    retry_set,
+                    retry_claimed,
+                    remaining,
+                    metadata,
                 )
                 ok_retries = 0
                 for rows, resp, err in retries:

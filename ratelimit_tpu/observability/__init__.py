@@ -12,6 +12,8 @@
                   (``GET /debug/incidents``).
 - ``slo``:        per-domain availability/latency SLIs and error-
                   budget burn rates (``GET /debug/slo``).
+- ``events``:     bounded lifecycle event journal — the ordered
+                  timeline behind an incident (``GET /debug/events``).
 """
 
 from .detectors import (
@@ -23,12 +25,19 @@ from .detectors import (
     OverLimitSurgeDetector,
     QueueSaturationDetector,
 )
+from .events import EVENT_TYPES, EventJournal, make_event_journal
 from .flight import (
+    CORR_HEADER,
+    FLIGHT_CODE_DEGRADED,
     FLIGHT_CODE_FALLBACK,
+    FLIGHT_CODE_FORWARDED,
     FLIGHT_CODE_SHED,
     FLIGHT_DTYPE,
     FlightRecorder,
+    format_corr,
     make_flight_recorder,
+    mint_corr,
+    parse_corr,
 )
 from .hotkeys import HotKeyEntry, HotKeySketch
 from .slo import SloEngine
@@ -47,13 +56,18 @@ from .trace import (
 )
 
 __all__ = [
+    "CORR_HEADER",
+    "EVENT_TYPES",
     "NOOP_SPAN",
     "TRACEPARENT_HEADER",
     "AnomalyDetectors",
     "Detector",
     "ErrorRateDetector",
+    "EventJournal",
     "Ewma",
+    "FLIGHT_CODE_DEGRADED",
     "FLIGHT_CODE_FALLBACK",
+    "FLIGHT_CODE_FORWARDED",
     "FLIGHT_CODE_SHED",
     "FLIGHT_DTYPE",
     "FinishedTrace",
@@ -69,8 +83,12 @@ __all__ = [
     "SpanContext",
     "TRACER",
     "Tracer",
+    "format_corr",
     "format_traceparent",
     "log_exporter",
+    "make_event_journal",
     "make_flight_recorder",
+    "mint_corr",
+    "parse_corr",
     "parse_traceparent",
 ]

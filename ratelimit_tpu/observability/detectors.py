@@ -268,6 +268,7 @@ class AnomalyDetectors:
         cooldown_s: float = 60.0,
         clock: Optional[MonotonicClock] = None,
         overload=None,
+        events=None,
     ):
         """``overload`` (overload/controller.py), when wired, rides
         the sampler: every TRIPPED detector evaluation is forwarded to
@@ -275,13 +276,18 @@ class AnomalyDetectors:
         backpressure hold must keep extending while the condition
         persists, even when no new incident is captured), and
         ``overload.tick()`` runs once per sampler tick after the
-        detectors, so control actions use this tick's signals."""
+        detectors, so control actions use this tick's signals.
+        ``events`` (observability/events.py), when wired, folds the
+        journal's live window into every incident capture — the
+        lifecycle narrative next to the decision evidence — and stamps
+        the capture itself onto the timeline."""
         self.store = store
         self.detectors = list(detectors)
         self.flight = flight
         self.tracer = tracer
         self.slo = slo
         self.overload = overload
+        self.events = events
         self.incident_dir = incident_dir
         self.incident_max = max(1, int(incident_max))
         self.interval_s = float(interval_s)
@@ -352,6 +358,14 @@ class AnomalyDetectors:
             "counters": self.store.counters(),
             "gauges": self.store.gauges(),
             "slo": self.slo.summary() if self.slo is not None else None,
+            # The lifecycle narrative around the anomaly (events.py):
+            # quarantines, floor moves, reloads — time-ordered, so the
+            # report answers "what was CHANGING when this tripped".
+            "events": (
+                self.events.snapshot()
+                if self.events is not None
+                else []
+            ),
         }
         self._incidents.append(incident)
         self.captured += 1
@@ -362,6 +376,15 @@ class AnomalyDetectors:
             reason,
             incident["id"],
         )
+        if self.events is not None:
+            # AFTER the snapshot above on purpose: the incident's own
+            # entry belongs to the NEXT capture's window, not its own.
+            self.events.emit(
+                "incident",
+                incident=incident["id"],
+                detector=detector,
+                reason=reason,
+            )
         if self.incident_dir:
             self._write_incident(incident)
         return incident

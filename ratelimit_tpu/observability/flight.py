@@ -84,6 +84,13 @@ FLIGHT_DTYPE = np.dtype(
         # candidate's models/registry.py algo_id (0 otherwise).
         ("code2", np.int64),
         ("algo", np.int64),
+        # Cross-hop correlation id (cluster/proxy.py mints one 63-bit
+        # id per proxied request and carries it in gRPC metadata): the
+        # SAME value lands in the proxy's ring, the owner replica's
+        # ring and the replica's trace spans, so one grep joins the
+        # hop-by-hop story.  0 = no correlation (standalone replica or
+        # the feature is off).
+        ("corr", np.int64),
     ]
 )
 
@@ -123,6 +130,39 @@ FLIGHT_CODE_FORWARDED = 10
 #: outside-the-protocol rationale as FLIGHT_CODE_SHED.
 FLIGHT_CODE_FALLBACK = 11
 
+#: gRPC metadata key the proxy uses to carry the per-request
+#: correlation id to the owner replica (cluster/proxy.py mints it,
+#: server/grpc_server.py adopts it).  Rendered hex16, like a W3C
+#: parent-id, so log greps work across rings, spans and metadata.
+CORR_HEADER = "x-ratelimit-corr"
+
+_CORR_MASK = 0x7FFFFFFFFFFFFFFF  # keep the int64 ring field positive
+
+
+def mint_corr() -> int:
+    """One non-zero 63-bit correlation id (proxy request intake)."""
+    import os
+
+    while True:
+        corr = int.from_bytes(os.urandom(8), "big") & _CORR_MASK
+        if corr:
+            return corr
+
+
+def format_corr(corr: int) -> str:
+    return f"{corr & 0xFFFFFFFFFFFFFFFF:016x}"
+
+
+def parse_corr(value: str) -> int:
+    """Metadata intake: malformed values degrade to 0 (no
+    correlation), never to an error — observability must not fail a
+    request."""
+    try:
+        corr = int(value, 16)
+    except (TypeError, ValueError):
+        return 0
+    return corr & _CORR_MASK
+
 
 class _Note(threading.local):
     """Per-thread (stem_hash, lane) deposit from the backend's request
@@ -135,6 +175,12 @@ class _Note(threading.local):
     value: tuple = (0, -1)
     shadow: tuple = (-1, 0)
     fallback: bool = False
+    # Correlation id is STICKY, not consumed: the transport handler
+    # overwrites it at request INTAKE (including to 0 when the hop
+    # carried no id), so every record a request stamps — handler
+    # stamp, router forwarded/degraded sentinels — shares the id, and
+    # a thread can never inherit a previous request's id.
+    corr: int = 0
 
 
 class FlightRecorder:
@@ -181,6 +227,13 @@ class FlightRecorder:
         its algorithm id — backends/tpu_cache.py deposits after the
         divergence comparison); consumed by the next ``record()``."""
         self._note.shadow = (code2, algo_id)
+
+    def note_corr(self, corr: int) -> None:
+        """Adopt the request's correlation id for this thread (set at
+        request intake by the transport handler — proxy or replica —
+        BEFORE any record for the request can be stamped).  Sticky
+        until the next intake on this thread; see _Note.corr."""
+        self._note.corr = corr
 
     def note_fallback(self) -> None:
         """Mark this thread's in-flight request as answered by the
@@ -251,6 +304,7 @@ class FlightRecorder:
                 bis(bounds, latency_ms),
                 code2,
                 algo,
+                note.corr,  # sticky per-request id; see _Note.corr
             )
 
         return record
@@ -308,7 +362,7 @@ class FlightRecorder:
         for rec in live[::-1].tolist():
             (
                 seq, ts_ns, dom, stem, lane, code, hits, bucket,
-                code2, algo,
+                code2, algo, corr,
             ) = rec
             d = {
                 "seq": seq,
@@ -322,6 +376,10 @@ class FlightRecorder:
                     bounds[bucket] if bucket < len(bounds) else float("inf")
                 ),
             }
+            if corr:
+                # Cross-hop correlation id, rendered in the same hex16
+                # form the gRPC metadata and trace spans carry.
+                d["corr"] = f"{corr & 0xFFFFFFFFFFFFFFFF:016x}"
             if code2 != -1:
                 # Shadow-mode dual record: the candidate kernel's
                 # would-be code + its algorithm-table name.

@@ -101,6 +101,7 @@ class _NoopSpan:
 
     __slots__ = ()
     recording = False
+    sampled = False
     trace_id = ""
     span_id = ""
 
@@ -198,6 +199,14 @@ class Span:
     @property
     def trace_id(self) -> str:
         return self._buf.trace_id
+
+    @property
+    def sampled(self) -> bool:
+        """True when the HEAD decision chose this trace (inbound
+        sampled flag or the probabilistic draw) — the signal outbound
+        propagation keys on.  False on the error-capture-only path,
+        which records locally but commits only on a bad ending."""
+        return self._buf.head_sampled
 
     def set_attr(self, key: str, value) -> None:
         if self.attrs is None:

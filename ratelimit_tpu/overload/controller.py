@@ -261,6 +261,11 @@ class OverloadController:
         self.bp_trips = 0
         self._shed_counts: Dict[str, Dict[str, int]] = {}
         self._store = None
+        # Lifecycle event journal (observability/events.py), wired by
+        # the runner: shed-floor moves and backpressure engage/ratchet/
+        # release transitions land on the fleet timeline.  Transition
+        # paths only — admit() never emits.
+        self.events = None
 
     # -- hot path ---------------------------------------------------------
 
@@ -376,17 +381,31 @@ class OverloadController:
             now = self.clock.now()
             self.bp_trips += 1
             self._bp_until = now + self._bp_hold
-            if self._bp_gate is None:
+            engaged = self._bp_gate is None
+            if engaged:
                 self._bp_level = 1
             else:
                 self._bp_level = min(self._bp_level + 1, self._bp_max_level)
             tokens = max(1, self._bp_tokens >> (self._bp_level - 1))
-            if tokens != self._bp_gate_tokens or self._bp_gate is None:
+            changed = tokens != self._bp_gate_tokens or self._bp_gate is None
+            if changed:
                 # Rebuild at the new width; in-flight admissions hold
                 # a reference to the OLD gate and release into it (see
                 # admit's return contract), so no permit is lost.
                 self._bp_gate_tokens = tokens
                 self._bp_gate = threading.Semaphore(tokens)
+            if self.events is not None and (engaged or changed):
+                # Engage and every ratchet that actually narrowed the
+                # gate are timeline entries; a trip that merely extends
+                # the hold is counter noise, not a transition.
+                self.events.emit(
+                    "backpressure",
+                    action="engage" if engaged else "ratchet",
+                    level=self._bp_level,
+                    tokens=tokens,
+                    detector=name,
+                    reason=reason,
+                )
 
     # -- control tick -----------------------------------------------------
 
@@ -400,6 +419,8 @@ class OverloadController:
                 self._bp_gate = None
                 self._bp_gate_tokens = 0
                 self._bp_level = 0
+                if self.events is not None:
+                    self.events.emit("backpressure", action="release")
             if self.promotion is not None and self.hotkeys is not None:
                 self._tick_promotion_locked()
             if self.shed_enabled and self.slo is not None:
@@ -440,16 +461,27 @@ class OverloadController:
             if burn > protected:
                 protected = burn
         max_floor = len(self._levels) - 1
+        direction = None
         if protected > self.shed_burn_threshold and self._floor < max_floor:
             self._floor += 1  # tpu-lint: disable=lock-discipline -- _locked suffix contract: only called by tick() while holding self._lock
             self.shed_transitions += 1
+            direction = "raise"
         elif (
             self._floor > 0
             and protected < self.shed_burn_threshold * self.shed_clear_ratio
         ):
             self._floor -= 1  # tpu-lint: disable=lock-discipline -- _locked suffix contract: only called by tick() while holding self._lock
             self.shed_transitions += 1
+            direction = "lower"
         self._recompute_shed_locked()
+        if direction is not None and self.events is not None:
+            self.events.emit(
+                "shed_floor",
+                direction=direction,
+                floor=self._floor,
+                shed_below_priority=self._shed_below,
+                protected_burn=round(protected, 4),
+            )
 
     def _tick_promotion_locked(self) -> None:
         """Scan the hot-key sketch for promotion candidates: stems

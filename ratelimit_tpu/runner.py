@@ -221,6 +221,7 @@ class Runner:
         self.slo = None
         self.detectors = None
         self.overload = None
+        self.events = None
 
     # -- lifecycle (runner.go:76-143) -----------------------------------
 
@@ -309,6 +310,7 @@ class Runner:
             OverLimitSurgeDetector,
             QueueSaturationDetector,
             SloEngine,
+            make_event_journal,
             make_flight_recorder,
         )
 
@@ -318,6 +320,24 @@ class Runner:
             self.flight.register_stats(store)
             if hasattr(self.cache, "flight"):
                 self.cache.flight = self.flight
+
+        # Lifecycle event journal (observability/events.py;
+        # docs/OBSERVABILITY.md "Event journal").  One process-wide
+        # timeline: the backend's fault domain, the handoff
+        # export/import seams, the overload controller and the config
+        # reloader all stamp transitions into the same ring.  Emitters
+        # hold ``events=None`` when EVENT_JOURNAL_SIZE=0, so the
+        # disabled path carries no journal branches at all.
+        self.events = make_event_journal(
+            s.event_journal_size, jsonl_path=s.event_journal_jsonl
+        )
+        if self.events is not None:
+            self.events.register_stats(store)
+            if hasattr(self.cache, "events"):
+                self.cache.events = self.events
+            fd = getattr(self.cache, "fault_domain", None)
+            if fd is not None:
+                fd.events = self.events
         self.slo = SloEngine(
             self.stats_manager,
             target=s.slo_target,
@@ -353,6 +373,7 @@ class Runner:
                 backpressure_max_wait_s=s.backpressure_max_wait_s,
                 backpressure_hold_s=s.backpressure_hold_s,
             )
+            self.overload.events = self.events
             self.overload.register_stats(store)
             if self.overload.promotion is not None and hasattr(
                 self.cache, "promotion"
@@ -401,6 +422,7 @@ class Runner:
         # priority ladder follows the same pattern.
         self.service.slo = self.slo
         self.service.overload = self.overload
+        self.service.events = self.events
         config = self.service.get_current_config()
         if config is not None:
             self.slo.set_domains(config.domains.keys())
@@ -441,6 +463,7 @@ class Runner:
             interval_s=s.anomaly_interval_s,
             cooldown_s=s.anomaly_cooldown_s,
             overload=self.overload,
+            events=self.events,
         )
         self.detectors.register_stats(store)
         self.detectors.start()
@@ -482,6 +505,7 @@ class Runner:
             auth_token=s.grpc_auth_token,
             flight=self.flight,
             slo=self.slo,
+            corr_enabled=s.flight_corr_enabled,
         )
         self.grpc_server.start()
 
@@ -503,6 +527,7 @@ class Runner:
             overload=self.overload,
             flight=self.flight,
             cluster_handoff_enabled=s.cluster_handoff_enabled,
+            events=self.events,
         )
         add_healthcheck(self.debug_server, self.health)
         self.debug_server.start()
@@ -595,6 +620,8 @@ class Runner:
             TRACER.clear_exporters()
             self._trace_jsonl.close()
             self._trace_jsonl = None
+        if self.events is not None:
+            self.events.close()
         self._stopped.set()
 
 

@@ -246,6 +246,10 @@ ENDPOINT_BLURBS = {
         "device-path fault domain: per-bank quarantine state, fault "
         "counters, restart history (JSON)"
     ),
+    "/debug/events": (
+        "lifecycle event journal, time-ordered with ?since= cursor "
+        "(JSON)"
+    ),
     "/debug/incidents": "captured anomaly incident reports (JSON)",
     "/debug/slo": "per-domain SLI / error-budget burn summary (JSON)",
     "/debug/overload": (

@@ -94,24 +94,43 @@ def _ratelimit_handler(
     reporter: Optional[ServerReporter],
     flight=None,
     slo=None,
+    corr_enabled: bool = False,
 ):
     serialize = rls_pb2.RateLimitResponse.SerializeToString
     from ..api import Code as _Code
     from ..observability import FLIGHT_CODE_SHED as _SHED
+    from ..observability import CORR_HEADER as _CORR_KEY
+    from ..observability import format_corr as _format_corr
+    from ..observability import parse_corr as _parse_corr
+
+    # Correlation intake only pays when BOTH the knob is on and a ring
+    # exists to stamp (FLIGHT_CORR_ENABLED; off by default — the
+    # metadata scan and note write are new per-request cost).
+    corr_on = bool(corr_enabled) and flight is not None
 
     def should_rate_limit(request_pb, context):
         start = time.perf_counter()
         # Trace intake: an inbound W3C traceparent (Envoy and any OTel
         # client send one as plain metadata) adopts the caller's trace
         # id and sampling decision; otherwise head-sampling applies.
-        # The metadata scan is gated so a disabled tracer costs one
-        # attribute load.
+        # The metadata scan is gated so a disabled tracer (and a
+        # disabled correlation knob) costs one attribute load.  The
+        # proxy's correlation id rides the same scan: one pass serves
+        # both keys.
         traceparent = None
-        if TRACER.enabled:
+        corr = 0
+        if TRACER.enabled or corr_on:
             for k, v in context.invocation_metadata():
                 if k == TRACEPARENT_HEADER:
                     traceparent = v
-                    break
+                elif k == _CORR_KEY:
+                    corr = _parse_corr(v)
+        if corr_on:
+            # Sticky intake stamp: EVERY request (re)writes the
+            # thread-local, including corr=0, so a handler thread can
+            # never bleed a previous request's id into this one's
+            # flight records.
+            flight.note_corr(corr)
         root = TRACER.start_span("grpc.should_rate_limit", traceparent)
         try:
             with root:
@@ -149,6 +168,11 @@ def _ratelimit_handler(
                 t_serialized = time.perf_counter()
                 root.set_attr("domain", request.domain)
                 root.set_attr("descriptors", len(request.descriptors))
+                if corr:
+                    # The span-tree side of the cross-hop join: the
+                    # same hex16 id the proxy stamped into its ring
+                    # and metadata (observability/flight.py).
+                    root.set_attr("corr", _format_corr(corr))
                 if response.overall_code == _Code.OVER_LIMIT:
                     # Tail-sampling override: over-limit decisions are
                     # always worth keeping (observability/trace.py).
@@ -324,6 +348,7 @@ def create_grpc_server(
     auth_token: str = "",
     flight=None,
     slo=None,
+    corr_enabled: bool = False,
 ) -> grpc.Server:
     """Build (not start) the server; port 0 picks a free port.  The
     bound port is stored on the returned server as ``bound_port``.
@@ -350,7 +375,13 @@ def create_grpc_server(
     )
     server.add_generic_rpc_handlers(
         (
-            _ratelimit_handler(service, reporter, flight=flight, slo=slo),
+            _ratelimit_handler(
+                service,
+                reporter,
+                flight=flight,
+                slo=slo,
+                corr_enabled=corr_enabled,
+            ),
             _health_handler(health),
         )
     )

@@ -256,6 +256,7 @@ def add_debug_routes(
     overload=None,
     flight=None,
     cluster_handoff_enabled: bool = False,
+    events=None,
 ) -> None:
     """/stats, /rlconfig, /metrics, /debug/* (server_impl.go:254-261,
     runner.go:117-124).  ``profiling_enabled`` (the DEBUG_PROFILING
@@ -265,7 +266,10 @@ def add_debug_routes(
     ``overload`` (overload/controller.py) opens /debug/overload;
     ``cluster_handoff_enabled`` (CLUSTER_HANDOFF_ENABLED) opens the
     counter-handoff admin POSTs under /debug/cluster (the GET summary
-    is always on)."""
+    is always on); ``events`` (observability/events.py,
+    EVENT_JOURNAL_SIZE) opens /debug/events — the replica's lifecycle
+    timeline, with a ``since=`` seq cursor for pollers (the proxy's
+    /fleet.json scrape resumes where it left off)."""
 
     def stats(h) -> None:
         lines = []
@@ -540,6 +544,37 @@ def add_debug_routes(
             content_type="application/json",
         )
 
+    def events_view(h) -> None:
+        # Lifecycle timeline zPage (observability/events.py): the
+        # ordered transition narrative — quarantines, handoffs, shed
+        # floors, reloads — behind whatever the counters are counting.
+        # ?since=<seq> resumes a poller at its last-seen cursor.
+        if events is None:
+            h._reply(
+                404, b"event journal disabled (EVENT_JOURNAL_SIZE=0)\n"
+            )
+            return
+        from urllib.parse import parse_qs, urlsplit
+
+        qs = parse_qs(urlsplit(h.path).query)
+        try:
+            since = int(qs.get("since", ["0"])[0])
+        except ValueError:
+            h._reply(400, b"bad since= cursor (want an integer)\n")
+            return
+        h._reply(
+            200,
+            json.dumps(
+                {
+                    "emitted": events.emitted,
+                    "counts": events.counts(),
+                    "events": events.snapshot(since=since),
+                }
+            ).encode(),
+            content_type="application/json",
+        )
+
+    server.add_route("GET", "/debug/events", events_view)
     server.add_route("GET", "/debug/faults", faults)
     server.add_route("GET", "/debug/incidents", incidents)
     server.add_route("GET", "/debug/slo", slo_summary)

@@ -54,6 +54,10 @@ class RateLimitService:
     # the request path byte-identical to a build without the control
     # layer.
     overload = None
+    # Lifecycle event journal (observability/events.py), attached by
+    # the runner: every adopted config generation lands on the fleet
+    # timeline (reload is a transition, never a request-path action).
+    events = None
 
     def __init__(
         self,
@@ -130,6 +134,12 @@ class RateLimitService:
         if self.overload is not None:
             # Same ordering contract for the shed-priority ladder.
             self.overload.set_priorities(new_config.priorities)
+        if self.events is not None:
+            self.events.emit(
+                "config_reload",
+                generation=new_config.generation,
+                domains=len(new_config.domains),
+            )
         with self._config_lock:
             self._config = new_config
             if self._settings_reloader is not None:

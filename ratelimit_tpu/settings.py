@@ -259,6 +259,22 @@ class Settings:
     # snapshot into incident reports.  0 disables recording entirely
     # (the serving path pays one attribute load + branch).
     flight_recorder_size: int = 4096
+    # Cross-hop correlation intake (observability/flight.py): adopt
+    # the x-ratelimit-corr metadata the cluster proxy mints and stamp
+    # it into this replica's flight records + trace spans, so one id
+    # joins the proxy ring, this ring and the span tree.  Off by
+    # default — the intake adds a metadata-scan branch per request.
+    flight_corr_enabled: bool = False
+    # Lifecycle event journal (observability/events.py): ring slots
+    # for the typed transition timeline (bank quarantine/restart,
+    # handoff export/import, shed floor, backpressure, config reload,
+    # incident captures) served at /debug/events and folded into
+    # incident JSON.  Emission is transition-only (zero per-request
+    # cost); 0 disables the journal entirely.
+    event_journal_size: int = 1024
+    # Optional JSONL mirror of every journal event (append-only; the
+    # incident-dir analog for the timeline).  Empty disables.
+    event_journal_jsonl: str = ""
     # Anomaly detectors (observability/detectors.py): sampler cadence;
     # 0 disables the sampler thread (and incident capture).  The
     # shared knobs below tune the EWMA-baselined triggers — see
@@ -440,6 +456,9 @@ def new_settings() -> Settings:
         hotkeys_top_k=_env_int("HOTKEYS_TOP_K", 128),
         debug_profiling=_env_bool("DEBUG_PROFILING", False),
         flight_recorder_size=_env_int("FLIGHT_RECORDER_SIZE", 4096),
+        flight_corr_enabled=_env_bool("FLIGHT_CORR_ENABLED", False),
+        event_journal_size=_env_int("EVENT_JOURNAL_SIZE", 1024),
+        event_journal_jsonl=_env_str("EVENT_JOURNAL_JSONL", ""),
         anomaly_interval_s=_env_float("ANOMALY_INTERVAL_S", 5.0),
         anomaly_spike_factor=_env_float("ANOMALY_SPIKE_FACTOR", 4.0),
         anomaly_min_samples=_env_int("ANOMALY_MIN_SAMPLES", 20),

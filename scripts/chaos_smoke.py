@@ -47,8 +47,13 @@ from ratelimit_tpu.observability import (  # noqa: E402
     FLIGHT_CODE_FALLBACK,
     make_flight_recorder,
 )
+from ratelimit_tpu.observability.events import EventJournal  # noqa: E402
+from ratelimit_tpu.server.http_server import (  # noqa: E402
+    HttpServer,
+    add_debug_routes,
+)
 from ratelimit_tpu.service import CacheError  # noqa: E402
-from ratelimit_tpu.stats.manager import Manager  # noqa: E402
+from ratelimit_tpu.stats.manager import Manager, StatsStore  # noqa: E402
 from ratelimit_tpu.utils.time import PinnedTimeSource  # noqa: E402
 
 YAML = """
@@ -93,13 +98,17 @@ def build_cache(inj, controlled, mode="host"):
     )
 
 
-def run_leg(controlled):
+def run_leg(controlled, journal=None):
     """One leg: load + probe traffic, hang injected mid-run, heal,
-    then (controlled) wait for the warm restart.  Returns metrics."""
+    then (controlled) wait for the warm restart.  Returns metrics.
+    ``journal`` (observability/events.py) rides the fault domain so
+    the quarantine episode lands on the lifecycle timeline."""
     inj = DeviceFaultInjector()
     cache = build_cache(inj, controlled)
     flight = make_flight_recorder(4096)
     cache.flight = flight
+    if journal is not None and cache.fault_domain is not None:
+        cache.fault_domain.events = journal
     mgr = Manager()
     cfg = load_config([ConfigFile("config.c", YAML)], mgr)
     probe_rule = cfg.get_limit("chaos", Descriptor.of(("probe", "p")))
@@ -253,7 +262,8 @@ def run_mode_matrix():
 def main() -> int:
     checks = []
     print("== controlled leg (fault domain armed, mode=host) ==")
-    ctl = run_leg(controlled=True)
+    journal = EventJournal(size=256)
+    ctl = run_leg(controlled=True, journal=journal)
     print(json.dumps(ctl, indent=2))
     print("== uncontrolled leg (fault domain off) ==")
     unc = run_leg(controlled=False)
@@ -305,12 +315,49 @@ def main() -> int:
         f"deny -> {matrix['deny']['answers']}",
     )
 
+    # The lifecycle journal, read back over the REAL debug endpoint:
+    # the controlled episode must appear as quarantine -> fallback ->
+    # restart, in timestamp order (docs/OBSERVABILITY.md event table).
+    srv = HttpServer("127.0.0.1", 0, name="chaos-debug")
+    add_debug_routes(srv, StatsStore(), events=journal)
+    srv.start()
+    try:
+        import urllib.request
+
+        body = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.bound_port}/debug/events", timeout=5
+            ).read()
+        )
+    finally:
+        srv.stop()
+    served = body["events"]
+    types = [e["type"] for e in served]
+
+    def first(etype):
+        return types.index(etype) if etype in types else None
+
+    order = [first("bank_quarantine"), first("bank_fallback"),
+             first("bank_restart")]
+    check(
+        checks,
+        "journal_quarantine_fallback_restart_in_order",
+        all(i is not None for i in order)
+        and order == sorted(order)
+        and all(
+            a["ts_mono_ns"] <= b["ts_mono_ns"]
+            for a, b in zip(served, served[1:])
+        ),
+        f"/debug/events timeline: {types}",
+    )
+
     result = {
         "kernel_deadline_s": KERNEL_DEADLINE_S,
         "uncontrolled_dispatch_timeout_s": UNCONTROLLED_DISPATCH_TIMEOUT_S,
         "controlled": ctl,
         "uncontrolled": unc,
         "failure_mode_matrix": matrix,
+        "events": types,
         "checks": checks,
     }
     out = os.path.join(

@@ -14,14 +14,22 @@ the proxy's RouterHolder, then:
    handoff coordinator driving the REAL HTTP admin endpoints
    (POST /debug/cluster/export|import, CLUSTER_HANDOFF_ENABLED
    semantics): asserts the moved counter did NOT restart its window
-   and the ratelimit.cluster.* handoff counters moved.
+   and the ratelimit.cluster.* handoff counters moved;
+4. kills + heals a replica on the NEW router and asserts the shared
+   lifecycle event journal recorded the whole episode in order —
+   kill->replica_eject ... handoff_end ... replica_readmit — then
+   scrapes the proxy's GET /fleet.json and asserts it merges >=2 live
+   replicas (per-replica /metrics liveness, SLO sections, and the
+   cross-replica event timeline with the proxy's own ``_proxy`` rows).
 
 Run:  JAX_PLATFORMS=cpu python scripts/cluster_smoke.py
 """
 
+import json
 import os
 import sys
 import time
+import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -33,7 +41,12 @@ from ratelimit_tpu.cluster.handoff import (  # noqa: E402
     HttpAdminTransport,
 )
 from ratelimit_tpu.cluster.hashing import owner_id  # noqa: E402
-from ratelimit_tpu.cluster.proxy import RouterHolder  # noqa: E402
+from ratelimit_tpu.cluster.proxy import (  # noqa: E402
+    RouterHolder,
+    start_debug_server,
+)
+from ratelimit_tpu.observability.events import EventJournal  # noqa: E402
+from ratelimit_tpu.observability.slo import SloEngine  # noqa: E402
 from ratelimit_tpu.cluster.router import ReplicaRouter  # noqa: E402
 from ratelimit_tpu.server.codec import (  # noqa: E402
     request_from_pb,
@@ -88,16 +101,27 @@ class Replica:
         self.cache = TpuRateLimitCache(
             CounterEngine(num_slots=1 << 10, buckets=(8, 32)), clock
         )
+        # Per-replica lifecycle journal: the handoff seams stamp
+        # handoff_export/handoff_import here, and /debug/events serves
+        # it so the proxy's /fleet.json can merge the fleet timeline.
+        self.journal = EventJournal(size=64)
+        self.cache.events = self.journal
+        self.manager = Manager()
         self.service = RateLimitService(
             _Runtime({"config.smoke": YAML}), self.cache, Manager()
         )
-        self.manager = Manager()
+        # Real SLO engine on the serving path so the proxy's
+        # /fleet.json has per-replica burn sections to merge.
+        self.slo = SloEngine(self.manager)
+        self.service.slo = self.slo
         self.debug = HttpServer("127.0.0.1", 0, name="smoke-debug")
         add_debug_routes(
             self.debug,
             self.manager.store,
             self.service,
+            slo=self.slo,
             cluster_handoff_enabled=True,
+            events=self.journal,
         )
         self.debug.start()
 
@@ -138,21 +162,34 @@ def main() -> int:
     ids3 = ["r1", "r2", "r3"]
     replicas = {rid: Replica(clock) for rid in ids3}
     faults = FaultInjector()
+    # The PROXY's journal: router eject/readmit + holder membership/
+    # handoff events land here, and the debug listener serves it.
+    journal = EventJournal(size=256)
 
-    def make_router(ids):
+    def make_router(ids, readmit_after_s=60.0):
         return ReplicaRouter(
             ids,
             [faults.wrap(rid, replicas[rid].transport()) for rid in ids],
             eject_after=2,
-            readmit_after_s=60.0,
+            readmit_after_s=readmit_after_s,
             failure_policy="local-cache",
             retry_max=1,
             retry_base_s=0.001,
+            events=journal,
         )
 
     admins = {rid: HttpAdminTransport(r.admin_url) for rid, r in replicas.items()}
     holder = RouterHolder(
-        make_router(ids2), handoff=HandoffCoordinator(admins.get).run
+        make_router(ids2),
+        handoff=HandoffCoordinator(admins.get).run,
+        events=journal,
+    )
+    debug = start_debug_server(
+        holder,
+        "127.0.0.1",
+        0,
+        admin_urls={rid: r.admin_url for rid, r in replicas.items()},
+        events=journal,
     )
     try:
         # A key that will MOVE to r3 when it joins (and is owned by a
@@ -208,7 +245,9 @@ def main() -> int:
         # window does NOT restart — the first request on the new
         # owner is still OVER.
         faults.heal()
-        holder.swap(make_router(ids3), grace_s=0.5)
+        # Short probation on the joined router so step 4's readmission
+        # happens inside the smoke budget.
+        holder.swap(make_router(ids3, readmit_after_s=0.5), grace_s=0.5)
         deadline = time.monotonic() + 10.0
         while holder.last_handoff is None and time.monotonic() < deadline:
             time.sleep(0.01)
@@ -228,9 +267,83 @@ def main() -> int:
             "ratelimit.cluster.* handoff counters moved on the joiner",
             snap["imported_keys"] + snap["merged_keys"] >= 1,
         )
+
+        # 4. Kill + heal r3 on the joined router: the journal must
+        # hold the WHOLE episode in order — the step-2 kill's eject,
+        # the step-3 handoff, then this readmission.
+        r3_key = next(
+            f"r3x{i}"
+            for i in range(10_000)
+            if owner_id(f"smoke_k_r3x{i}_", ids3) == "r3"
+        )
+        faults.kill("r3")
+        for _ in range(4):  # burn through eject_after=2 (+retry)
+            holder.should_rate_limit(pb_request(r3_key))
+        faults.heal()
+        deadline = time.monotonic() + 10.0
+        while (
+            not any(e["type"] == "replica_readmit" for e in journal.snapshot())
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.1)  # let the 0.5 s probation lapse
+            holder.should_rate_limit(pb_request(r3_key))
+        events = journal.snapshot()
+        types = [e["type"] for e in events]
+
+        def first(etype):
+            return types.index(etype) if etype in types else None
+
+        order = [
+            first("replica_eject"),
+            first("membership_change"),
+            first("handoff_begin"),
+            first("handoff_end"),
+            first("replica_readmit"),
+        ]
+        check(
+            "journal records kill->eject->handoff->readmit in order",
+            all(i is not None for i in order) and order == sorted(order),
+        )
+        check(
+            "journal timestamps are monotone with seq",
+            all(
+                a["ts_mono_ns"] <= b["ts_mono_ns"]
+                for a, b in zip(events, events[1:])
+            ),
+        )
+
+        # The proxy's debug listener merges the live fleet.
+        base = f"http://127.0.0.1:{debug.bound_port}"
+        served = json.loads(
+            urllib.request.urlopen(base + "/debug/events", timeout=5).read()
+        )
+        check(
+            "proxy /debug/events serves the journal",
+            [e["type"] for e in served["events"]] == types,
+        )
+        fleet = json.loads(
+            urllib.request.urlopen(base + "/fleet.json", timeout=10).read()
+        )
+        live = [
+            rid
+            for rid, r in fleet["replicas"].items()
+            if r.get("metrics", {}).get("up")
+        ]
+        check("/fleet.json merges two live replicas", len(live) >= 2)
+        check(
+            "/fleet.json merges per-replica SLO sections",
+            all("domains" in fleet["replicas"][rid]["slo"] for rid in live),
+        )
+        merged_replicas = {e["replica"] for e in fleet["events"]}
+        check(
+            "/fleet.json timeline interleaves replica + proxy events",
+            "_proxy" in merged_replicas
+            and any(rid in merged_replicas for rid in ids3),
+        )
         print("cluster smoke: all checks passed")
         return 0
     finally:
+        debug.stop()
         holder.close()
         for r in replicas.values():
             r.stop()

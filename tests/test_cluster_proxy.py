@@ -613,3 +613,269 @@ def test_watcher_keeps_membership_on_unparseable_entry(tmp_path):
         stop.set()
         t.join(timeout=5)
         holder.close()
+
+
+def _call_md(addr, request_pb, metadata):
+    with grpc.insecure_channel(addr) as channel:
+        method = channel.unary_unary(
+            "/envoy.service.ratelimit.v3.RateLimitService/ShouldRateLimit",
+            request_serializer=rls_pb2.RateLimitRequest.SerializeToString,
+            response_deserializer=rls_pb2.RateLimitResponse.FromString,
+        )
+        return method(request_pb, timeout=30, metadata=metadata)
+
+
+def test_proxy_stats_shape_handoff_age_and_circuit_open_since():
+    """Satellite regression: /stats.json carries last_handoff_age_s
+    (seconds since the last counter transfer completed) and per-replica
+    open_since_s (age of the current outage, null while closed) — the
+    two numbers a runbook reader triages a membership event with."""
+    import json as _json
+    import time as _t
+    import urllib.request
+
+    from ratelimit_tpu.cluster.proxy import RouterHolder, start_debug_server
+    from ratelimit_tpu.cluster.router import ReplicaRouter
+    from ratelimit_tpu.observability.events import EventJournal
+
+    def dead(req, timeout_s=None, metadata=None):
+        raise ConnectionError("down")
+
+    def ok(req, timeout_s=None, metadata=None):
+        resp = rls_pb2.RateLimitResponse(
+            overall_code=rls_pb2.RateLimitResponse.OK
+        )
+        for _ in req.descriptors:
+            resp.statuses.add(code=rls_pb2.RateLimitResponse.OK)
+        return resp
+
+    journal = EventJournal(size=32)
+    holder = RouterHolder(
+        ReplicaRouter(["r0:1", "r1:2"], [dead, ok], eject_after=1,
+                      readmit_after_s=60.0),
+        handoff=lambda old, new: {
+            "old": old, "new": new, "moved_keys": 2, "imported": 2,
+            "merged": 0, "dropped": 0, "duration_s": 0.01,
+        },
+        events=journal,
+    )
+    srv = start_debug_server(holder, "127.0.0.1", 0, events=journal)
+    try:
+        base = f"http://127.0.0.1:{srv.bound_port}"
+
+        def stats():
+            return _json.loads(
+                urllib.request.urlopen(base + "/stats.json", timeout=5).read()
+            )
+
+        snap = stats()
+        assert "last_handoff_age_s" not in snap  # no handoff yet
+        states = {s["id"]: s for s in snap["replica_states"]}
+        assert states["r0:1"]["state"] == "closed"
+        assert states["r0:1"]["open_since_s"] is None
+
+        # Trip r0's circuit through the serving path.
+        for _ in range(3):
+            holder.should_rate_limit(_request("shape"))
+        snap = stats()
+        states = {s["id"]: s for s in snap["replica_states"]}
+        open_states = [
+            s for s in states.values() if s["state"] != "closed"
+        ]
+        assert open_states, "killing a replica must open a circuit"
+        assert all(
+            isinstance(s["open_since_s"], float) and s["open_since_s"] >= 0
+            for s in open_states
+        )
+
+        # A membership swap with a handoff coordinator stamps the
+        # journal (membership_change -> handoff_begin -> handoff_end)
+        # and /stats.json gains the age of the completed transfer.
+        holder.swap(
+            ReplicaRouter(["r0:1", "r1:2", "r2:3"], [ok, ok, ok]),
+            grace_s=0.1,
+        )
+        deadline = _t.monotonic() + 5
+        while holder.last_handoff is None and _t.monotonic() < deadline:
+            _t.sleep(0.02)
+        snap = stats()
+        assert isinstance(snap["last_handoff_age_s"], float)
+        assert snap["last_handoff_age_s"] >= 0.0
+        assert snap["last_handoff"]["moved_keys"] == 2
+        types = [e["type"] for e in journal.snapshot()]
+        assert types[:3] == [
+            "membership_change", "handoff_begin", "handoff_end"
+        ]
+        ended = [
+            e for e in journal.snapshot() if e["type"] == "handoff_end"
+        ][0]
+        assert ended["ok"] is True and ended["moved_keys"] == 2
+        # The proxy debug listener serves the same timeline.
+        body = _json.loads(
+            urllib.request.urlopen(base + "/debug/events", timeout=5).read()
+        )
+        assert [e["type"] for e in body["events"]][:3] == types[:3]
+    finally:
+        srv.stop()
+        holder.close()
+
+
+def test_traceparent_propagates_proxy_to_replica(stack):
+    """Cross-hop trace correlation, span-tree half: a sampled inbound
+    W3C traceparent rides proxy -> replica gRPC metadata, so the
+    replica's committed trace carries the CALLER's trace id and parents
+    onto the proxy's root span — one trace id joins both hops."""
+    from ratelimit_tpu.observability import TRACER
+
+    runners, router, server, proxy_addr = stack
+    TRACER.clear()
+    tid = "ab" * 16
+    sid = "cd" * 8
+    _call_md(
+        proxy_addr,
+        _request("tracehop"),
+        [("traceparent", f"00-{tid}-{sid}-01")],
+    )
+    traces = [t for t in TRACER.recent() if t.trace_id == tid]
+    by_name = {t.root_name: t for t in traces}
+    assert set(by_name) == {
+        "proxy.should_rate_limit", "grpc.should_rate_limit"
+    }
+    proxy_t = by_name["proxy.should_rate_limit"]
+    replica_t = by_name["grpc.should_rate_limit"]
+    assert proxy_t.sampled and replica_t.sampled
+    # The proxy parents onto the caller's span; the replica parents
+    # onto the proxy's ROOT span (the id its outbound header carried).
+    assert proxy_t.parent_id == sid
+    proxy_root = [
+        s for s in proxy_t.spans if s["name"] == "proxy.should_rate_limit"
+    ][0]
+    assert replica_t.parent_id == proxy_root["span_id"]
+
+
+def test_corr_id_joins_proxy_ring_replica_ring_and_span_tree(tmp_path):
+    """Cross-hop correlation, ring half: the proxy mints one corr id,
+    stamps it into ITS flight ring, carries it in x-ratelimit-corr to
+    the owner replica (FLIGHT_CORR_ENABLED=true), where the SAME hex16
+    id lands in the replica's ring and its trace span attrs — one grep
+    joins the hop-by-hop story (the PR's acceptance criterion)."""
+    from ratelimit_tpu.cluster.proxy import build_router, make_server
+    from ratelimit_tpu.observability import TRACER, make_flight_recorder
+
+    root = tmp_path / "corr"
+    config_dir = root / "ratelimit" / "config"
+    config_dir.mkdir(parents=True)
+    (config_dir / "px.yaml").write_text(YAML)
+    r = Runner(
+        Settings(
+            host="127.0.0.1",
+            port=0,
+            grpc_host="127.0.0.1",
+            grpc_port=0,
+            debug_host="127.0.0.1",
+            debug_port=0,
+            use_statsd=False,
+            backend_type="memory",
+            runtime_path=str(root),
+            runtime_subdirectory="ratelimit",
+            local_cache_size_in_bytes=0,
+            expiration_jitter_max_seconds=0,
+            flight_recorder_size=64,
+            flight_corr_enabled=True,
+        ),
+        time_source=PinnedTimeSource(1_000_000),
+    )
+    r.start()
+    proxy_flight = make_flight_recorder(64)
+    router = build_router(
+        [f"127.0.0.1:{r.grpc_server.bound_port}"], flight=proxy_flight
+    )
+    server, bound = make_server(
+        router, "127.0.0.1", 0, flight=proxy_flight
+    )
+    server.start()
+    try:
+        TRACER.clear()
+        # Sampled inbound traceparent so the replica's span commits
+        # (corr attrs ride committed traces only).
+        _call_md(
+            f"127.0.0.1:{bound}",
+            _request("corrjoin"),
+            [("traceparent", f"00-{'12' * 16}-{'34' * 8}-01")],
+        )
+        proxy_recs = proxy_flight.snapshot_dicts()
+        assert proxy_recs and "corr" in proxy_recs[0]
+        corr = proxy_recs[0]["corr"]
+        assert len(corr) == 16 and int(corr, 16) != 0
+        # Same id in the owner replica's ring...
+        replica_corrs = [
+            rec.get("corr") for rec in r.flight.snapshot_dicts()
+        ]
+        assert corr in replica_corrs
+        # ...and on the replica's committed span tree.
+        replica_traces = [
+            t for t in TRACER.recent()
+            if t.root_name == "grpc.should_rate_limit"
+            and t.trace_id == "12" * 16
+        ]
+        assert replica_traces
+        root_span = [
+            s for s in replica_traces[0].spans
+            if s["name"] == "grpc.should_rate_limit"
+        ][0]
+        assert root_span["attrs"]["corr"] == corr
+        # The proxy ring's route note: the router deposited the chosen
+        # replica (lane = owner index; stem = crc32(replica id)).
+        assert proxy_recs[0]["lane"] == 0
+    finally:
+        server.stop(grace=None)
+        router.close()
+        r.stop()
+
+
+def test_proxy_fleet_json_merges_two_live_replicas(stack):
+    """/fleet.json scrapes BOTH replicas' debug listeners through the
+    --replica-admin map and returns one merged body: per-replica
+    scrape health, fleet SLO/hotkeys/faults merges, and the proxy's
+    own journal interleaved into the merged timeline as ``_proxy``."""
+    import json as _json
+    import urllib.request
+
+    from ratelimit_tpu.cluster.proxy import RouterHolder, start_debug_server
+    from ratelimit_tpu.observability.events import EventJournal
+
+    runners, router, server, proxy_addr = stack
+    admin_urls = {
+        f"127.0.0.1:{r.grpc_server.bound_port}":
+            f"http://127.0.0.1:{r.debug_server.bound_port}"
+        for r in runners
+    }
+    journal = EventJournal(size=16)
+    journal.emit("membership_change", old=[], new=sorted(admin_urls))
+    holder = RouterHolder(router, events=journal)
+    srv = start_debug_server(
+        holder, "127.0.0.1", 0, admin_urls=admin_urls, events=journal
+    )
+    try:
+        base = f"http://127.0.0.1:{srv.bound_port}"
+        fleet = _json.loads(
+            urllib.request.urlopen(base + "/fleet.json", timeout=10).read()
+        )
+        assert set(fleet["replicas"]) == set(admin_urls)
+        for rid in admin_urls:
+            scraped = fleet["replicas"][rid]
+            assert scraped["metrics"]["up"] is True
+            assert "domains" in scraped["slo"]
+        # The merged SLO carries the serving domain from live replicas
+        # (the module fixture drove px traffic through them).
+        assert "px" in fleet["slo"]["domains"]
+        assert fleet["slo"]["domains"]["px"]["replicas"] >= 1
+        assert "quarantined_banks" in fleet["faults"]
+        assert fleet["proxy"]["replicas"] == 2
+        # The proxy's own journal rides the merged timeline.
+        proxy_events = [
+            e for e in fleet["events"] if e["replica"] == "_proxy"
+        ]
+        assert [e["type"] for e in proxy_events] == ["membership_change"]
+    finally:
+        srv.stop()

@@ -1,0 +1,381 @@
+"""Fleet observability plane, unit half: the lifecycle EventJournal
+(observability/events.py — ring wrap, cursor contract, typed-only
+emission, statsd counters, JSONL export), the /debug/events HTTP
+surface, the wrapped-ring /debug/flight dump (one snapshot per
+request), and the proxy's FleetAggregator merges (cluster/fleet.py)
+over the fetch seam.  The cross-process e2e half lives in
+test_cluster_proxy.py."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ratelimit_tpu.observability import make_flight_recorder
+from ratelimit_tpu.observability.events import (
+    EVENT_TYPES,
+    EventJournal,
+    make_event_journal,
+)
+from ratelimit_tpu.stats.manager import StatsStore
+from ratelimit_tpu.utils.time import FakeMonotonicClock
+
+
+def _get(port, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    )
+
+
+# ---------------------------------------------------------------------------
+# EventJournal
+# ---------------------------------------------------------------------------
+
+
+def test_journal_emit_snapshot_ordering_and_fields():
+    clock = FakeMonotonicClock(10.0)
+    wall = [1700000000.0]
+    j = EventJournal(size=16, clock=clock, wall=lambda: wall[0])
+    j.emit("bank_quarantine", bank=0, kind="hang")
+    clock.advance(0.5)
+    wall[0] += 0.5
+    j.emit("bank_fallback", bank=0, mode="host")
+    clock.advance(0.5)
+    wall[0] += 0.5
+    j.emit("bank_restart", bank=0, restarts=1)
+
+    events = j.snapshot()
+    assert [e["type"] for e in events] == [
+        "bank_quarantine",
+        "bank_fallback",
+        "bank_restart",
+    ]
+    assert [e["seq"] for e in events] == [1, 2, 3]
+    # Monotonic stamps order the timeline; the unix stamp is display.
+    assert events[0]["ts_mono_ns"] < events[1]["ts_mono_ns"]
+    assert events[0]["ts_unix"] < events[2]["ts_unix"]
+    # Detail kwargs render verbatim in the row.
+    assert events[0]["bank"] == 0 and events[0]["kind"] == "hang"
+    assert events[2]["restarts"] == 1
+
+
+def test_journal_rejects_unknown_type():
+    j = EventJournal(size=4)
+    with pytest.raises(ValueError, match="unknown event type"):
+        j.emit("bank_exploded")
+
+
+def test_journal_ring_wrap_keeps_newest_window():
+    j = EventJournal(size=4)
+    for i in range(10):
+        j.emit("config_reload", generation=i)
+    events = j.snapshot()
+    # Only the last `size` survive the wrap, in seq order.
+    assert [e["seq"] for e in events] == [7, 8, 9, 10]
+    assert [e["generation"] for e in events] == [6, 7, 8, 9]
+    # Tallies count EMITTED, not retained.
+    assert j.emitted == 10
+    assert j.counts()["config_reload"] == 10
+
+
+def test_journal_since_cursor_is_resumable():
+    j = EventJournal(size=16)
+    for i in range(5):
+        j.emit("shed_floor", floor=i)
+    first = j.snapshot()
+    cursor = first[-1]["seq"]
+    assert j.snapshot(since=cursor) == []
+    j.emit("shed_floor", floor=99)
+    fresh = j.snapshot(since=cursor)
+    assert len(fresh) == 1 and fresh[0]["floor"] == 99
+    # limit= keeps the NEWEST window (tail of the timeline).
+    tail = j.snapshot(limit=2)
+    assert [e["floor"] for e in tail] == [4, 99]
+
+
+def test_journal_register_stats_counters():
+    store = StatsStore()
+    j = EventJournal(size=8)
+    j.register_stats(store)
+    j.emit("backpressure", action="engage")
+    j.emit("backpressure", action="release")
+    j.emit("incident", incident="inc-1")
+    values = store.counter_fn_values()
+    assert values["ratelimit.events.backpressure"] == 2
+    assert values["ratelimit.events.incident"] == 1
+    assert values["ratelimit.events.emitted"] == 3
+    # Every type in the bounded family is pre-registered (cardinality
+    # is a code review, not a runtime property).
+    for etype in EVENT_TYPES:
+        assert f"ratelimit.events.{etype}" in values
+
+
+def test_journal_jsonl_export(tmp_path):
+    path = tmp_path / "events.jsonl"
+    j = EventJournal(size=8, jsonl_path=str(path))
+    j.emit("handoff_begin", old=["a:1"], new=["a:1", "b:2"])
+    j.emit("handoff_end", ok=True, moved_keys=3)
+    j.close()
+    lines = [
+        json.loads(ln)
+        for ln in path.read_text().splitlines()
+        if ln.strip()
+    ]
+    assert [l["type"] for l in lines] == ["handoff_begin", "handoff_end"]
+    assert lines[0]["new"] == ["a:1", "b:2"]
+    assert lines[1]["moved_keys"] == 3
+
+
+def test_make_event_journal_maps_zero_to_none():
+    assert make_event_journal(0) is None
+    assert make_event_journal(-5) is None
+    assert isinstance(make_event_journal(16), EventJournal)
+
+
+# ---------------------------------------------------------------------------
+# /debug/events + wrapped-ring /debug/flight
+# ---------------------------------------------------------------------------
+
+
+def test_debug_events_endpoint_cursor_and_404_when_disabled():
+    from ratelimit_tpu.server.http_server import HttpServer, add_debug_routes
+
+    j = EventJournal(size=8)
+    j.emit("replica_eject", replica="r1:2")
+    j.emit("replica_readmit", replica="r1:2")
+    server = HttpServer("127.0.0.1", 0, name="ev-dbg")
+    add_debug_routes(server, StatsStore(), events=j)
+    server.start()
+    try:
+        with _get(server.bound_port, "/debug/events") as r:
+            body = json.loads(r.read())
+        assert body["emitted"] == 2
+        assert body["counts"]["replica_eject"] == 1
+        assert [e["type"] for e in body["events"]] == [
+            "replica_eject",
+            "replica_readmit",
+        ]
+        cursor = body["events"][-1]["seq"]
+        with _get(server.bound_port, f"/debug/events?since={cursor}") as r:
+            assert json.loads(r.read())["events"] == []
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(server.bound_port, "/debug/events?since=banana")
+        assert e.value.code == 400
+    finally:
+        server.stop()
+
+    # Journal off -> 404, mirroring /debug/flight's disabled answer.
+    server = HttpServer("127.0.0.1", 0, name="ev-dbg-off")
+    add_debug_routes(server, StatsStore())
+    server.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(server.bound_port, "/debug/events")
+        assert e.value.code == 404
+    finally:
+        server.stop()
+
+
+def test_debug_flight_jsonl_wrapped_ring_single_snapshot():
+    """Regression (satellite fix): dumping a WRAPPED ring must take one
+    snapshot per request — every line valid JSON, exactly `size` rows,
+    seqs strictly consecutive oldest-first with no duplicate or torn
+    rows from re-reading the ring mid-dump."""
+    from ratelimit_tpu.server.http_server import HttpServer, add_debug_routes
+
+    flight = make_flight_recorder(4)
+    for i in range(11):  # wraps the 4-slot ring ~3x
+        flight.note(i, i % 2)
+        flight.record(f"d{i % 3}", 0, 1, 0.5)
+    server = HttpServer("127.0.0.1", 0, name="fl-wrap")
+    add_debug_routes(
+        server, StatsStore(), profiling_enabled=True, flight=flight
+    )
+    server.start()
+    try:
+        with _get(server.bound_port, "/debug/flight?format=jsonl") as r:
+            lines = [ln for ln in r.read().decode().splitlines() if ln]
+        recs = [json.loads(ln) for ln in lines]
+        assert len(recs) == 4  # exactly the live window, nothing stale
+        seqs = [r["seq"] for r in recs]
+        assert seqs == [8, 9, 10, 11]  # consecutive, oldest first
+        # format=json shares the SAME snapshot (taken once, before the
+        # format branch), so its window is identical.
+        with _get(server.bound_port, "/debug/flight?format=json") as r:
+            body = json.loads(r.read())
+        assert [r["seq"] for r in body["records"]] == [8, 9, 10, 11]
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# FleetAggregator (fetch seam)
+# ---------------------------------------------------------------------------
+
+
+class _Holder:
+    """Stats-only stand-in for RouterHolder."""
+
+    def __init__(self, stats):
+        self._stats = stats
+
+    def stats(self):
+        return self._stats
+
+
+def _replica_bodies(rid):
+    """One replica's debug surfaces, parameterized so merges have
+    something to disagree about."""
+    burn = 2.0 if rid == "r1:2" else 0.5
+    return {
+        "/metrics": b"# HELP ...\n",
+        "/debug/slo": json.dumps(
+            {
+                "target": 0.999,
+                "domains": {
+                    "chat": {
+                        "window": {
+                            "requests": 100,
+                            "over_limit": 10,
+                            "errors": 1,
+                            "slow": 2,
+                            "burn_rate": burn,
+                        }
+                    }
+                },
+            }
+        ).encode(),
+        "/debug/hotkeys": json.dumps(
+            {
+                "tracked": 2,
+                "keys": [
+                    {"key": "chat/user_u1", "hits": 50, "over_limit": 5,
+                     "near_limit": 1},
+                    {"key": f"chat/only_{rid}", "hits": 7, "over_limit": 0,
+                     "near_limit": 0},
+                ],
+            }
+        ).encode(),
+        "/debug/faults": json.dumps(
+            {
+                "restarts": 1,
+                "fallback_decisions": 3,
+                "banks": [
+                    {"bank": 0, "state": "closed"},
+                    {
+                        "bank": 1,
+                        "state": "quarantined" if rid == "r0:1" else "closed",
+                    },
+                ],
+            }
+        ).encode(),
+        "/debug/cluster": json.dumps(
+            {"handoff_enabled": True, "handoff": None}
+        ).encode(),
+        "/debug/events": json.dumps(
+            {
+                "emitted": 1,
+                "events": [
+                    {
+                        "seq": 1,
+                        "ts_unix": 100.0 if rid == "r0:1" else 50.0,
+                        "type": "bank_quarantine",
+                        "bank": 1,
+                    }
+                ],
+            }
+        ).encode(),
+    }
+
+
+def _make_agg(admin_urls, journal=None, fail=()):
+    from ratelimit_tpu.cluster.fleet import FleetAggregator
+
+    fetched = []
+
+    def fetch(url):
+        fetched.append(url)
+        for rid, base in admin_urls.items():
+            if url.startswith(base):
+                path = url[len(base):]
+                if (rid, path) in fail:
+                    raise ConnectionError("scrape down")
+                return _replica_bodies(rid)[path]
+        raise AssertionError(f"unexpected url {url}")
+
+    agg = FleetAggregator(admin_urls, timeout_s=1.0, events=journal,
+                          fetch=fetch)
+    return agg, fetched
+
+
+def test_fleet_merges_slo_hotkeys_faults_events():
+    admin = {"r0:1": "http://h0:6070", "r1:2": "http://h1:6070"}
+    journal = EventJournal(size=8, wall=lambda: 75.0)
+    journal.emit("membership_change", old=["r0:1"], new=["r0:1", "r1:2"])
+    agg, _ = _make_agg(admin, journal=journal)
+    holder = _Holder(
+        {"replicas": 2, "replica_states": [
+            {"id": "r0:1", "state": "closed"},
+            {"id": "r1:2", "state": "closed"},
+        ]}
+    )
+    fleet = agg.fleet(holder)
+
+    assert set(fleet["replicas"]) == {"r0:1", "r1:2"}
+    assert fleet["replicas"]["r0:1"]["metrics"]["up"] is True
+    assert fleet["proxy"]["replicas"] == 2
+
+    chat = fleet["slo"]["domains"]["chat"]
+    assert chat["requests"] == 200 and chat["over_limit"] == 20
+    assert chat["replicas"] == 2
+    # Requests-weighted burn: (2.0*100 + 0.5*100) / 200.
+    assert chat["burn_rate"] == pytest.approx(1.25)
+    assert chat["max_burn_rate"] == 2.0
+    assert fleet["slo"]["max_burn"] == {
+        "replica": "r1:2", "domain": "chat", "burn_rate": 2.0
+    }
+
+    keys = {k["key"]: k for k in fleet["hotkeys"]["keys"]}
+    # A key hot on BOTH replicas sums and ranks first.
+    assert keys["chat/user_u1"]["hits"] == 100
+    assert sorted(keys["chat/user_u1"]["replicas"]) == ["r0:1", "r1:2"]
+    assert fleet["hotkeys"]["keys"][0]["key"] == "chat/user_u1"
+    assert fleet["hotkeys"]["tracked"] == 3
+
+    # Only the non-closed bank surfaces, tagged with its replica.
+    q = fleet["faults"]["quarantined_banks"]
+    assert q == [{"replica": "r0:1", "bank": 1, "state": "quarantined"}]
+    assert fleet["faults"]["restarts"] == 2
+    assert fleet["faults"]["fallback_decisions"] == 6
+
+    # Events merge on wall clock: r1 (50) < proxy (75) < r0 (100).
+    tl = [(e["replica"], e["type"]) for e in fleet["events"]]
+    assert tl == [
+        ("r1:2", "bank_quarantine"),
+        ("_proxy", "membership_change"),
+        ("r0:1", "bank_quarantine"),
+    ]
+
+    assert fleet["cluster"]["r0:1"]["handoff_enabled"] is True
+
+
+def test_fleet_skips_open_circuits_and_degrades_per_endpoint():
+    admin = {"r0:1": "http://h0:6070", "r1:2": "http://h1:6070"}
+    # r1's circuit is open: the fleet view must not spend its deadline
+    # re-learning what the routing tier already knows.
+    agg, fetched = _make_agg(admin, fail=(("r0:1", "/debug/slo"),))
+    holder = _Holder(
+        {"replica_states": [
+            {"id": "r0:1", "state": "closed"},
+            {"id": "r1:2", "state": "open", "open_since_s": 3.2},
+        ]}
+    )
+    fleet = agg.fleet(holder)
+    assert fleet["replicas"]["r1:2"] == {"skipped": "circuit open"}
+    assert not any("h1:6070" in u for u in fetched)
+    # One failed endpoint degrades THAT section only; the rest render.
+    assert "error" in fleet["replicas"]["r0:1"]["slo"]
+    assert fleet["slo"]["domains"] == {}
+    assert fleet["replicas"]["r0:1"]["metrics"]["up"] is True
+    assert fleet["hotkeys"]["keys"][0]["key"] == "chat/user_u1"
