@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: all test lint sanitize native-asan sanitize-native bench bench-host replay-smoke cluster-smoke chaos-smoke protos native serve check_config smoke_client metrics-smoke docker_image e2e e2e-local ci clean
+.PHONY: all test lint sanitize native-asan sanitize-native bench bench-host perf-gate replay-smoke cluster-smoke chaos-smoke protos native serve check_config smoke_client metrics-smoke docker_image e2e e2e-local ci clean
 
 # C++ hot-path library: slot table + decide kernel (auto-built on
 # first import too; this forces it).  Goes through the Python builder
@@ -59,6 +59,15 @@ bench:
 # docs/HOST_PATH.md.  Pure host work; no device step.
 bench-host:
 	$(CPU_ENV) $(PY) benchmarks/profile_host_path.py --quick
+
+# Perf-regression ratchet: the committed benchmarks/results artifacts
+# checked against the committed budgets (benchmarks/perf_budget.json)
+# — hard ceilings plus a >25% creep check vs each metric's last
+# baselined value.  After intentionally regenerating artifacts, run
+# `python scripts/perf_gate.py --write-baseline` (ceilings are
+# hand-edited only).  Pure stdlib, no jax needed.
+perf-gate:
+	$(PY) scripts/perf_gate.py --fail-on-new
 
 # Overload-control smoke: replay the committed tiny flight ring
 # (benchmarks/data/flight_ring_sample.jsonl) at forced overload
@@ -132,7 +141,7 @@ e2e-local:
 # The full CI recipe (.github/workflows/ci.yaml runs exactly this):
 # native build, tests, offline config validation, black-box e2e,
 # bench smoke on the CPU platform.
-ci: lint native test sanitize sanitize-native check_config metrics-smoke bench-host replay-smoke cluster-smoke chaos-smoke e2e-local
+ci: lint perf-gate native test sanitize sanitize-native check_config metrics-smoke bench-host replay-smoke cluster-smoke chaos-smoke e2e-local
 	$(CPU_ENV) PALLAS_AXON_POOL_IPS= $(PY) bench.py
 
 clean:

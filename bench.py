@@ -234,6 +234,86 @@ def _bench_algorithm(name: str) -> float:
     return BATCH * k * ALGO_CALLS / elapsed
 
 
+def _bench_launches() -> dict:
+    """Drive a real BatchDispatcher with a launch recorder attached
+    (bursts of 8 under an open 50ms window, flushed per burst) and
+    return the ring-derived digest — launches, coalescing, phase
+    p99s — for the BENCH record's ``launches`` section."""
+    from ratelimit_tpu.backends.dispatcher import (
+        BatchDispatcher,
+        Lane,
+        WorkItem,
+    )
+    from ratelimit_tpu.backends.engine import CounterEngine
+    from ratelimit_tpu.observability.launches import (
+        OUTCOME_OK,
+        make_launch_recorder,
+    )
+
+    engine = CounterEngine(num_slots=1 << 12)
+    d = BatchDispatcher(engine, batch_window_us=50_000, batch_limit=4096)
+    lr = make_launch_recorder(1 << 10)
+    try:
+        # Warm the jit cache BEFORE attaching the recorder, so the
+        # ring digests steady-state launches, not the XLA compile.
+        warm = WorkItem(
+            now=1_700_000_000,
+            lanes=[
+                Lane(
+                    key="bench_warm_0",
+                    expiry=1_700_000_060,
+                    limit=1000,
+                    shadow=False,
+                    hits=1,
+                )
+            ],
+            apply=lambda dec: None,
+        )
+        d.submit(warm)
+        d.flush()
+        warm.wait(30.0)
+        d.launches = lr
+        for burst in range(64):
+            items = [
+                WorkItem(
+                    now=1_700_000_000,
+                    lanes=[
+                        Lane(
+                            key=f"bench_k{(burst * 8 + j) % 128}_0",
+                            expiry=1_700_000_060,
+                            limit=1000,
+                            shadow=False,
+                            hits=1,
+                        )
+                    ],
+                    apply=lambda dec: None,
+                )
+                for j in range(8)
+            ]
+            for it in items:
+                d.submit(it)
+            d.flush()
+            for it in items:
+                it.wait(10.0)
+    finally:
+        d.stop()
+    live = lr.snapshot()
+    ok = live[live["outcome"] == OUTCOME_OK]
+    return {
+        "launches": int(lr.stamped()),
+        "items": int(live["items"].sum()),
+        "coalesce_items_per_launch": lr.coalesce_ratio(),
+        "p99_launch_us": round(lr.p99_launch_ns() / 1e3, 1),
+        "p99_complete_us": (
+            round(float(np.percentile(ok["complete_ns"], 99)) / 1e3, 1)
+            if len(ok)
+            else 0.0
+        ),
+        "ok": int(len(ok)),
+        "faults": int(len(live) - len(ok)),
+    }
+
+
 def main() -> None:
     import os
     import threading
@@ -364,6 +444,19 @@ def main() -> None:
 
     decisions_per_sec = decisions / elapsed
 
+    # --- launch flight recorder (observability/launches.py) -----------
+    # A short serving-path leg through a REAL dispatcher with the
+    # recorder attached: the BENCH record carries the ring-derived
+    # coalescing + phase digest so the launch-shape trajectory is
+    # tracked round over round alongside raw kernel throughput.
+    launches = _bench_launches()
+    print(
+        json.dumps(
+            {"event": "launches_bench", "platform": platform, **launches}
+        ),
+        flush=True,
+    )
+
     # --- pluggable-algorithm kernels (models/registry.py) -------------
     algorithms = {"fixed_window": round(decisions_per_sec, 1)}
     for algo in ("sliding_window", "gcra"):
@@ -393,6 +486,7 @@ def main() -> None:
                 ),
                 "platform": platform,
                 "algorithms": algorithms,
+                "launches": launches,
             }
         )
     )

@@ -8,6 +8,13 @@ records the RSS trajectory.  Passing = RSS flat at steady state
 the early ramp is the slot table / memo / allocator arenas filling to
 capacity).
 
+The RSS trajectory is sampled twice on purpose: the script's own
+10s poll (the raw ``rss_samples`` rows) AND a live
+observability.timeseries sampler thread running exactly as it does in
+serving — the flat-ceiling assertion runs against BOTH, so a
+regression in the tsdb path itself (a leak, a dead sampler, a torn
+ring) fails the soak even when the raw poll looks flat.
+
 Run:  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python benchmarks/soak.py \
           [--seconds 180] [--threads 4]
 Writes benchmarks/results/soak_rss.json.
@@ -60,6 +67,10 @@ def main(argv=None) -> None:
     from ratelimit_tpu.backends.tpu_cache import TpuRateLimitCache
     from ratelimit_tpu.backends.write_behind import WriteBehindRateLimitCache
     from ratelimit_tpu.config.loader import ConfigFile, load_config
+    from ratelimit_tpu.observability.timeseries import (
+        TimeSeriesStore,
+        register_default_series,
+    )
     from ratelimit_tpu.stats.manager import Manager
 
     mgr = Manager()
@@ -74,6 +85,13 @@ def main(argv=None) -> None:
         batch_window_us=200,
     )
     cache.warmup()
+    # Live time-series sampler, wired exactly as runner.start does
+    # (default series incl. the rss_mb gauge), ticking on its own
+    # thread for the whole soak; interval sized for >=24 live rows.
+    ts_interval = max(2.0, args.seconds / 36.0)
+    ts = TimeSeriesStore(ts_interval, retention_s=2.0 * args.seconds)
+    register_default_series(ts, mgr.store, cache=cache)
+    ts.start()
     stop = threading.Event()
     sent = [0]
     errors: list = []
@@ -112,12 +130,25 @@ def main(argv=None) -> None:
     stop.set()
     for t in threads:
         t.join(timeout=20)
+    ts.stop()
     cache.flush()
     cache.close()
     assert not errors, errors
 
     early = float(np.mean([s["rss_mb"] for s in samples[2:5]]))
     late = float(np.mean([s["rss_mb"] for s in samples[-3:]]))
+
+    # The live series is the second witness: the sampler thread must
+    # have kept ticking, and ITS rss_mb trajectory must plateau too.
+    snap = ts.snapshot()
+    ts_rss = [v for v in snap["series"].get("rss_mb", []) if v is not None]
+    assert len(ts_rss) >= 8, (
+        f"tsdb sampler recorded only {len(ts_rss)} live rss rows "
+        f"(interval {ts_interval:.1f}s over {args.seconds}s)"
+    )
+    k = max(2, len(ts_rss) // 8)
+    ts_early = float(np.mean(ts_rss[1 : 1 + k]))
+    ts_late = float(np.mean(ts_rss[-k:]))
     out = {
         "note": (
             f"{args.seconds}s closed-loop soak ({args.backend} backend), "
@@ -132,6 +163,15 @@ def main(argv=None) -> None:
         "rss_early_mb": round(early, 1),
         "rss_late_mb": round(late, 1),
         "growth_mb": round(late - early, 1),
+        "timeseries": {
+            "interval_s": round(ts_interval, 1),
+            "live_rows": len(ts_rss),
+            "rss_series_mb": [round(v, 1) for v in ts_rss],
+            "rss_early_mb": round(ts_early, 1),
+            "rss_late_mb": round(ts_late, 1),
+            "growth_mb": round(ts_late - ts_early, 1),
+            "summary": ts.summary(),
+        },
     }
     suffix = "" if args.backend == "sync" else "_wb"
     path = os.path.join(
@@ -146,6 +186,10 @@ def main(argv=None) -> None:
     )
     assert late - early < args.growth_bound_mb, (
         f"RSS grew {late - early:.1f}MB during soak"
+    )
+    assert ts_late - ts_early < args.growth_bound_mb, (
+        f"live timeseries rss_mb grew {ts_late - ts_early:.1f}MB "
+        "during soak"
     )
     print("SOAK PASSED")
 

@@ -25,11 +25,13 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..observability.launches import OUTCOME_FAULT, OUTCOME_OK
 from ..utils.time import REAL_MONOTONIC
 from .engine import HostDecisions
 
@@ -149,6 +151,14 @@ class WorkItem:
     # converts the stamps to dispatch/kernel spans after wait()
     # (observability/trace.py).  None on the unsampled hot path.
     trace: Optional[dict] = None
+    # Launch-recorder stamps (observability/launches.py), set only
+    # when a recorder is attached to the receiving dispatcher:
+    # `submit_ns` is monotonic_ns at intake (the queue-wait baseline);
+    # `corr` carries the request's cross-hop correlation id so the
+    # launch record can name its longest-queued rider.  Both stay 0 on
+    # the recorder-off path.
+    submit_ns: int = 0
+    corr: int = 0
     event: threading.Event = field(default_factory=threading.Event)
     error: Optional[BaseException] = None
 
@@ -401,6 +411,19 @@ class BatchDispatcher:
         # on the collector thread.  Lanes/items counts, not ms.
         self.batch_lanes_hist = None
         self.batch_items_hist = None
+        # Launch flight recorder (observability/launches.py), attached
+        # by TpuRateLimitCache.attach_launch_recorder together with
+        # this dispatcher's bank index + algorithm id.  None = off (one
+        # attribute load + branch per LAUNCH, never per item).  The
+        # meta deque carries the collector's per-launch measurements
+        # (shape, queue wait, launch duration, corr) to the completer
+        # in completion-queue order: appends/poplefts are GIL-atomic,
+        # both queues are FIFO with exactly one producer and one
+        # consumer, so entry k always meets its own batch.
+        self.launches = None
+        self.launch_bank = 0
+        self.launch_algo = 0
+        self._launch_meta: deque = deque()
         # Proactive slot-table gc: without it, expired keys linger in
         # the table until the free list empties (Redis expires keys
         # lazily too, but also actively samples; fixed 10-key-space
@@ -505,6 +528,10 @@ class BatchDispatcher:
             return max(v, len(self._buf))
 
     def submit(self, item: WorkItem) -> None:
+        if self.launches is not None:
+            # Queue-wait baseline for the launch record; recorder-off
+            # submits pay one attribute load + branch.
+            item.submit_ns = time.monotonic_ns()
         self._enqueue(item)
 
     def flush(self) -> None:
@@ -566,23 +593,30 @@ class BatchDispatcher:
         stopping = False
         lanes = 0
         deadline = None
+        # Hot-loop hoist (tpu-lint hot-path-cost): the cv once per
+        # _collect, not one attribute probe per wakeup.  `self._buf`
+        # itself must stay an attribute read — _die() (on the
+        # completer thread) swaps the list object under the cv, so a
+        # hoisted alias could drain a buffer nobody owns anymore.
+        buf_cv = self._buf_cv
 
         while True:
-            with self._buf_cv:
+            with buf_cv:
                 while not self._buf:
                     if deadline is None:
-                        self._buf_cv.wait()  # idle: block for work
+                        buf_cv.wait()  # idle: block for work
                     else:
                         timeout = deadline - time.monotonic()
-                        if timeout <= 0 or not self._buf_cv.wait(timeout):
+                        if timeout <= 0 or not buf_cv.wait(timeout):
                             if not self._buf:
                                 return batch, tokens, stopping
-                drained = self._buf
+                drained = self._buf  # tpu-lint: disable=hot-path-cost -- self._buf is re-read at every use on purpose: _die() swaps the list object
                 self._buf = []
-                if len(drained) > self._queue_hwm:
-                    self._queue_hwm = len(drained)
-                if len(drained) > self._queue_hwm_tick:
-                    self._queue_hwm_tick = len(drained)
+                n_drained = len(drained)
+                if n_drained > self._queue_hwm:
+                    self._queue_hwm = n_drained
+                if n_drained > self._queue_hwm_tick:
+                    self._queue_hwm_tick = n_drained
 
             cut = None
             try:
@@ -605,11 +639,11 @@ class BatchDispatcher:
                 # swap took out of the shared buffer would otherwise be
                 # orphaned in these locals — _die() can only fail what
                 # it can see.  Push it all back before propagating.
-                with self._buf_cv:
+                with buf_cv:
                     self._buf[:0] = batch + tokens + list(drained[i:])
                 raise
             if cut is not None and cut < len(drained):
-                with self._buf_cv:
+                with buf_cv:
                     self._buf[:0] = drained[cut:]
             if stopping or tokens or lanes >= self.batch_limit:
                 return batch, tokens, stopping
@@ -630,22 +664,68 @@ class BatchDispatcher:
 
     def _launch(self, batch: List[WorkItem]) -> None:
         """Launch on the collector thread, hand to the completer."""
+        lanes_total = None
         if self.batch_lanes_hist is not None:
             # One observe per LAUNCH (not per item): a bisect + adds
             # under the histogram lock, amortized across the batch.
-            self.batch_lanes_hist.observe(
-                sum(it.n_lanes for it in batch)
-            )
+            lanes_total = sum(it.n_lanes for it in batch)
+            self.batch_lanes_hist.observe(lanes_total)
         if self.batch_items_hist is not None:
             self.batch_items_hist.observe(len(batch))
+        lr = self.launches
+        queue_wait = corr = t0 = 0
+        if lr is not None:
+            # Launch-record front half: queue_wait is oldest submit ->
+            # here; the oldest item's corr joins the record to the
+            # request rings.  Once per LAUNCH, on this thread only.
+            if lanes_total is None:
+                lanes_total = sum(it.n_lanes for it in batch)
+            t0 = time.monotonic_ns()
+            oldest = 0
+            for it in batch:
+                s = it.submit_ns
+                if s and (oldest == 0 or s < oldest):
+                    oldest = s
+                    corr = it.corr
+            if oldest:
+                queue_wait = t0 - oldest
         self._launch_busy_since = self._stamp_now()
         try:
             token = submit_items(self.engine, batch)
         finally:
             self._launch_busy_since = None
         if token is _SUBMIT_FAILED:
+            if lr is not None:
+                lr.record(
+                    self.launch_bank,
+                    self.launch_algo,
+                    lanes_total,
+                    len(batch),
+                    int(getattr(self.engine, "stat_dedup_groups", 0)),
+                    queue_wait,
+                    time.monotonic_ns() - t0,
+                    0,
+                    OUTCOME_FAULT,
+                    corr,
+                )
             self._note_step(False)
         elif token is not None:
+            if lr is not None:
+                # FIFO meta pairing: the completer poplefts one entry
+                # per "batch" completion, and this append happens
+                # strictly before the matching _put_completion — one
+                # producer (collector), one consumer (completer), both
+                # FIFO, so entry k always meets its own batch.
+                self._launch_meta.append(  # tpu-lint: disable=shared-state -- deque append/popleft are GIL-atomic; one FIFO producer (collector) and one FIFO consumer (completer)
+                    (
+                        lanes_total,
+                        len(batch),
+                        int(getattr(self.engine, "stat_dedup_groups", 0)),
+                        queue_wait,
+                        time.monotonic_ns() - t0,
+                        corr,
+                    )
+                )
             with self._state_lock:
                 self._inflight += 1
                 if self._inflight > self._inflight_hwm:
@@ -656,25 +736,23 @@ class BatchDispatcher:
         """Bounded put that fails entries fast if the completer dies
         while the queue is full (instead of blocking the collector
         forever on a queue nobody drains)."""
-        while True:
-            if self._dead is not None:
-                err = DispatcherDead(
-                    f"batch dispatcher is dead: {self._dead!r}"
-                )
-                kind, payload, _token = entry
-                if kind == "batch":
-                    for it in payload:
-                        it.fail(err)
-                elif kind == "token":
-                    if isinstance(payload, _CallToken):
-                        payload.error = err
-                    payload.event.set()
-                return
+        while self._dead is None:
             try:
                 self._completion_q.put(entry, timeout=0.2)
                 return
             except queue.Full:
                 continue
+        # Dead path, reached at most once per call (the loop above
+        # exits to here): formatting happens outside the retry loop.
+        err = DispatcherDead(f"batch dispatcher is dead: {self._dead!r}")
+        kind, payload, _token = entry
+        if kind == "batch":
+            for it in payload:
+                it.fail(err)
+        elif kind == "token":
+            if isinstance(payload, _CallToken):
+                payload.error = err
+            payload.event.set()
 
     def _note_step(self, ok: bool) -> None:
         """Track consecutive device-step failures -> health state (the
@@ -792,11 +870,33 @@ class BatchDispatcher:
                 if kind == "token":
                     payload.event.set()
                 else:
+                    lr = self.launches
+                    t0 = time.monotonic_ns() if lr is not None else 0
                     self._complete_busy_since = self._stamp_now()
                     try:
                         ok = complete_items(self.engine, payload, token)
                     finally:
                         self._complete_busy_since = None
+                    if lr is not None:
+                        try:
+                            meta = self._launch_meta.popleft()
+                        except IndexError:
+                            # Recorder attached between this batch's
+                            # launch and its completion: no front-half
+                            # measurements, still one record.
+                            meta = (0, len(payload), 0, 0, 0, 0)
+                        lr.record(
+                            self.launch_bank,
+                            self.launch_algo,
+                            meta[0],
+                            meta[1],
+                            meta[2],
+                            meta[3],
+                            meta[4],
+                            time.monotonic_ns() - t0,
+                            OUTCOME_OK if ok else OUTCOME_FAULT,
+                            meta[5],
+                        )
                     if ok:
                         self.completed_launches += 1
                     with self._state_lock:

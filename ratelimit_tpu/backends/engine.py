@@ -413,6 +413,11 @@ class CounterEngine:
         # observers never call into the un-synchronized native table.
         self.stat_live_keys = 0
         self.stat_evictions = 0
+        # Unique slots across the LAST submitted batch's dedup groups:
+        # the launch recorder's dedup_groups field (same single-toucher
+        # discipline — written at the end of each submit, read by the
+        # dispatcher collector immediately after submit returns).
+        self.stat_dedup_groups = 0
         # Fresh slot sightings = window rollovers: a key entering a
         # new window is a new cache key whose first batch appearance
         # carries fresh=1 (the lazy-expiry seam).  Counted per dedup
@@ -493,6 +498,7 @@ class CounterEngine:
             self.stat_window_rollovers += int(np.count_nonzero(dedup.fresh))  # tpu-lint: disable=shared-state -- collector-owned engine
         self.stat_live_keys = len(self.slot_table)  # tpu-lint: disable=shared-state -- collector-owned engine
         self.stat_evictions = self.slot_table.evictions  # tpu-lint: disable=shared-state -- collector-owned engine
+        self.stat_dedup_groups = sum(len(c[3].uniq_slots) for c in chunks)  # tpu-lint: disable=shared-state -- collector-owned engine
         return (batch.hits, batch.limits, batch.shadow, chunks, now)
 
     def submit_packed(self, now: int, key_blob, meta: np.ndarray):
@@ -593,6 +599,9 @@ class CounterEngine:
             self.stat_window_rollovers += int(np.count_nonzero(dedup.fresh))
         self.stat_live_keys = len(table)
         self.stat_evictions = table.evictions
+        self.stat_dedup_groups = sum(
+            len(d.uniq_slots) for _, _, d in dedups
+        )
         return (hits, limits, shadow, chunks, now)
 
     def step_complete(self, token) -> HostDecisions:

@@ -49,10 +49,12 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Callable, List, Optional
 
 import numpy as np
 
+from ..observability.launches import OUTCOME_FALLBACK
 from ..utils.time import REAL_MONOTONIC, MonotonicClock
 from .host_engine import STATIC_ALLOW, STATIC_DENY, HostEngine
 
@@ -240,6 +242,12 @@ class DeviceFaultDomain:
         # restart outcomes land on the fleet timeline.  All emissions
         # are transition-path only — never per request.
         self.events = None
+        # Launch flight recorder (observability/launches.py), wired via
+        # cache.attach_launch_recorder: fallback answers are single-
+        # item host-side "launches" and stamp OUTCOME_FALLBACK records
+        # so the /debug/launches timeline shows a quarantined bank's
+        # traffic instead of going dark.
+        self.launches = None
 
     # -- hot-path surface (backends/tpu_cache.py _execute) --------------
 
@@ -260,6 +268,8 @@ class DeviceFaultDomain:
 
         rec = self._records[bank]
         mode = self.failure_mode
+        lr = self.launches
+        t0 = time.monotonic_ns() if lr is not None else 0
         if mode == MODE_DENY:
             run_items(STATIC_DENY, [item])
         elif mode == MODE_ALLOW or rec.fallback is None:
@@ -267,6 +277,22 @@ class DeviceFaultDomain:
         else:
             with rec.lock:
                 run_items(rec.fallback, [item])
+        if lr is not None:
+            # One OUTCOME_FALLBACK record per fallback answer: a
+            # single-item host-side "launch" with the whole duration
+            # in complete_ns (there is no device submit leg).
+            lr.record(
+                bank,
+                0,
+                item.n_lanes,
+                1,
+                0,
+                0,
+                0,
+                time.monotonic_ns() - t0,
+                OUTCOME_FALLBACK,
+                item.corr,
+            )
         # The event is already set; wait() applies the deferred slices
         # on THIS thread exactly like a healthy dispatcher completion.
         item.wait(5.0)

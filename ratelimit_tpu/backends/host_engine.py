@@ -223,14 +223,19 @@ class HostEngine:
 
     def import_state(self, state: dict) -> None:
         ns = self.model.num_slots
+        bad_row = bad_size = None
         for i, name in enumerate(self.spec.state_rows):
             arr = np.asarray(state[name], dtype=np.uint32).reshape(-1)
             if arr.shape[0] != ns:
-                raise ValueError(
-                    f"state row {name!r} size {arr.shape[0]} != "
-                    f"num_slots {ns}"
-                )
+                bad_row, bad_size = name, arr.shape[0]
+                break
             self.state[i] = arr
+        if bad_row is not None:
+            # Formatted OUTSIDE the loop (hot-path-cost): the message
+            # builds once on the cold error leg, never per row.
+            raise ValueError(
+                f"state row {bad_row!r} size {bad_size} != num_slots {ns}"
+            )
 
     def import_snapshot(self, state: dict, entries) -> int:
         """Seed the mirror from a bank's last pre-fault snapshot

@@ -261,6 +261,13 @@ class TpuRateLimitCache:
         # OVER_LIMIT cache, sketch-driven).  None = disabled (one
         # attribute load + branch per descriptor).
         self.promotion = None
+        # Launch flight recorder (observability/launches.py), attached
+        # by the runner via attach_launch_recorder when
+        # LAUNCH_RECORDER_SIZE > 0: every bank dispatcher stamps one
+        # ring record per device batch at its submit/complete seams,
+        # and quarantine fallbacks stamp through the fault domain.
+        # None = disabled (one attribute load + branch per launch).
+        self.launches = None
         self.expiration_jitter_max_seconds = int(expiration_jitter_max_seconds)
         self.jitter_rand = jitter_rand or random.Random()
         # Liveness backstop for dispatcher waits; generous because the
@@ -814,6 +821,36 @@ class TpuRateLimitCache:
             stamp_clock=self._stamp_clock,
         )
 
+    def attach_launch_recorder(self, recorder) -> None:
+        """Wire the launch flight recorder into every bank dispatcher
+        and the fault domain's fallback path (runner.start; _swap_bank
+        re-applies it to warm-restarted dispatchers)."""
+        self.launches = recorder
+        if self.fault_domain is not None:
+            self.fault_domain.launches = recorder
+        self._wire_launch_recorder()
+
+    def _bank_algo_id(self, bank: int) -> int:
+        """models/registry algo_id serving at `bank` (engines() order):
+        counter lanes and the per-second bank run fixed-window models;
+        algorithm banks carry their registry id."""
+        n_base = len(self.lanes) + (
+            1 if self.per_second_engine is not None else 0
+        )
+        if bank < n_base:
+            return 0
+        return ALGORITHMS[self._algo_order[bank - n_base]].algo_id
+
+    def _wire_launch_recorder(self) -> None:
+        """Point every live dispatcher at the recorder with its bank's
+        identity (stamped into each launch record)."""
+        for bank, eng in enumerate(self.engines()):
+            d = self._dispatchers.get(id(eng))
+            if d is not None:
+                d.launch_bank = bank
+                d.launch_algo = self._bank_algo_id(bank)
+                d.launches = self.launches
+
     def _swap_bank(self, bank: int, new_engine, new_dispatcher) -> None:
         """Install a warm-restarted engine + dispatcher at `bank`
         (called by the fault-domain supervisor with the bank's
@@ -838,6 +875,10 @@ class TpuRateLimitCache:
         if old_d is not None:
             new_dispatcher.batch_lanes_hist = old_d.batch_lanes_hist
             new_dispatcher.batch_items_hist = old_d.batch_items_hist
+        if self.launches is not None:
+            new_dispatcher.launches = self.launches
+            new_dispatcher.launch_bank = bank
+            new_dispatcher.launch_algo = self._bank_algo_id(bank)
         self._dispatchers[id(new_engine)] = new_dispatcher
         if self._health_hook is not None:
             states, states_lock, make_on_state = self._health_hook
@@ -990,6 +1031,15 @@ class TpuRateLimitCache:
         labels = self._bank_labels
         n_labels = len(labels)
         fd = self.fault_domain
+        # One thread-local read per REQUEST (not per item): the launch
+        # recorder joins a slow launch back to the request rings via
+        # the submitting thread's sticky correlation id.  0 when either
+        # ring is off — items then keep corr=0 and no store happens.
+        req_corr = (
+            self.flight.current_corr()
+            if self.launches is not None and self.flight is not None
+            else 0
+        )
         pending: List[tuple] = []  # (bank, engine, item) awaiting wait
         done: List[WorkItem] = []  # answered items (events recyclable)
         # Hot-loop hoist (tpu-lint hot-path-cost): the bound method
@@ -1023,6 +1073,8 @@ class TpuRateLimitCache:
             if d is None:
                 inline.append((bank, engine, item))
                 continue
+            if req_corr:
+                item.corr = req_corr
             try:
                 d.submit(item)
             except Exception as e:

@@ -22,7 +22,10 @@ exact replica that is down), and returns:
 - ``events``: the merged lifecycle timeline — each replica's journal
   window tagged with its replica id, ordered by wall clock (monotonic
   stamps do not compare across processes), interleaved with the
-  proxy's own journal under the id ``_proxy``.
+  proxy's own journal under the id ``_proxy``;
+- ``timeseries``: per-replica sparkline digests (last/avg/max per
+  series) from each replica's in-process time-series store — the
+  "is RSS climbing anywhere" answer without shipping ring history.
 
 Scrapes are best-effort per endpoint: one replica's 404 (feature off)
 or timeout degrades THAT section for THAT replica and the rest of the
@@ -51,6 +54,10 @@ REPLICA_ENDPOINTS: Tuple[Tuple[str, str], ...] = (
     ("faults", "/debug/faults"),
     ("cluster", "/debug/cluster"),
     ("events", "/debug/events"),
+    # The bounded per-series {last,avg,max} digest, NOT the full ring:
+    # the fleet page shows sparkline summaries (is RSS climbing on
+    # replica B), the history itself stays on the replica.
+    ("timeseries", "/debug/timeseries?summary=1"),
 )
 
 #: Union-top-K width of the merged hotkeys table.
@@ -259,4 +266,7 @@ class FleetAggregator:
                 rid: body for rid, body in sections["cluster"].items()
             },
             "events": self._merge_events(sections["events"], proxy_events),
+            "timeseries": {
+                rid: body for rid, body in sections["timeseries"].items()
+            },
         }

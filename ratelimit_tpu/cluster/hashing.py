@@ -38,11 +38,12 @@ def routing_key(domain: str, descriptor) -> str:
     ``.entries`` of ``.key``/``.value`` pairs (wire protos and
     api.Descriptor alike)."""
     parts = [domain, "_"]
+    append = parts.append  # hoisted: 4 loads/lane otherwise (tpu-lint)
     for entry in descriptor.entries:
-        parts.append(entry.key)
-        parts.append("_")
-        parts.append(entry.value)
-        parts.append("_")
+        append(entry.key)
+        append("_")
+        append(entry.value)
+        append("_")
     return "".join(parts)
 
 

@@ -355,7 +355,9 @@ class RateLimitConfig:
         for i, entry in enumerate(descriptor.entries):
             # Exact key_value child first, wildcard key child second
             # (config_impl.go:268-278).
-            node = children.get(f"{entry.key}_{entry.value}")
+            # Plain concat, not an f-string: this runs per entry on
+            # the config-tree walk of every unresolved descriptor.
+            node = children.get(entry.key + "_" + entry.value)
             if node is None:
                 node = children.get(entry.key)
             if node is not None and node.rule is not None and i == last:

@@ -14,6 +14,10 @@
                   budget burn rates (``GET /debug/slo``).
 - ``events``:     bounded lifecycle event journal — the ordered
                   timeline behind an incident (``GET /debug/events``).
+- ``launches``:   lock-free per-LAUNCH device-batch ring — the
+                  dispatch timeline (``GET /debug/launches``).
+- ``timeseries``: in-process bounded time-series store — capacity /
+                  latency history (``GET /debug/timeseries``).
 """
 
 from .detectors import (
@@ -40,7 +44,20 @@ from .flight import (
     parse_corr,
 )
 from .hotkeys import HotKeyEntry, HotKeySketch
+from .launches import (
+    LAUNCH_DTYPE,
+    OUTCOME_FALLBACK,
+    OUTCOME_FAULT,
+    OUTCOME_OK,
+    LaunchRecorder,
+    make_launch_recorder,
+)
 from .slo import SloEngine
+from .timeseries import (
+    TimeSeriesStore,
+    make_timeseries,
+    register_default_series,
+)
 from .trace import (
     NOOP_SPAN,
     TRACEPARENT_HEADER,
@@ -75,7 +92,12 @@ __all__ = [
     "HotKeyEntry",
     "HotKeySketch",
     "JsonlExporter",
+    "LAUNCH_DTYPE",
     "LatencySpikeDetector",
+    "LaunchRecorder",
+    "OUTCOME_FALLBACK",
+    "OUTCOME_FAULT",
+    "OUTCOME_OK",
     "OverLimitSurgeDetector",
     "QueueSaturationDetector",
     "SloEngine",
@@ -83,12 +105,16 @@ __all__ = [
     "SpanContext",
     "TRACER",
     "Tracer",
+    "TimeSeriesStore",
     "format_corr",
     "format_traceparent",
     "log_exporter",
     "make_event_journal",
     "make_flight_recorder",
+    "make_launch_recorder",
+    "make_timeseries",
     "mint_corr",
     "parse_corr",
     "parse_traceparent",
+    "register_default_series",
 ]

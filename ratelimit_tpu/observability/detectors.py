@@ -269,6 +269,7 @@ class AnomalyDetectors:
         clock: Optional[MonotonicClock] = None,
         overload=None,
         events=None,
+        timeseries=None,
     ):
         """``overload`` (overload/controller.py), when wired, rides
         the sampler: every TRIPPED detector evaluation is forwarded to
@@ -280,7 +281,10 @@ class AnomalyDetectors:
         ``events`` (observability/events.py), when wired, folds the
         journal's live window into every incident capture — the
         lifecycle narrative next to the decision evidence — and stamps
-        the capture itself onto the timeline."""
+        the capture itself onto the timeline.  ``timeseries``
+        (observability/timeseries.py), when wired, embeds the bounded
+        per-series {last,avg,max} digest — was RSS climbing, what was
+        the launch rate — next to the same evidence."""
         self.store = store
         self.detectors = list(detectors)
         self.flight = flight
@@ -288,6 +292,7 @@ class AnomalyDetectors:
         self.slo = slo
         self.overload = overload
         self.events = events
+        self.timeseries = timeseries
         self.incident_dir = incident_dir
         self.incident_max = max(1, int(incident_max))
         self.interval_s = float(interval_s)
@@ -365,6 +370,14 @@ class AnomalyDetectors:
                 self.events.snapshot()
                 if self.events is not None
                 else []
+            ),
+            # The capacity/latency history digest (timeseries.py):
+            # bounded per-series {last,avg,max} — answers "was this
+            # building up" without shipping the whole ring.
+            "timeseries": (
+                self.timeseries.summary()
+                if self.timeseries is not None
+                else {}
             ),
         }
         self._incidents.append(incident)

@@ -235,6 +235,12 @@ class FlightRecorder:
         until the next intake on this thread; see _Note.corr."""
         self._note.corr = corr
 
+    def current_corr(self) -> int:
+        """This RPC thread's sticky correlation id (0 = none) — read
+        at WorkItem build time so the launch recorder can point a slow
+        launch back at the request rings (observability/launches.py)."""
+        return self._note.corr
+
     def note_fallback(self) -> None:
         """Mark this thread's in-flight request as answered by the
         device-path failure-mode fallback (backends/fault_domain.py);

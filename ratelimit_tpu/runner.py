@@ -222,6 +222,8 @@ class Runner:
         self.detectors = None
         self.overload = None
         self.events = None
+        self.launches = None
+        self.timeseries = None
 
     # -- lifecycle (runner.go:76-143) -----------------------------------
 
@@ -312,6 +314,9 @@ class Runner:
             SloEngine,
             make_event_journal,
             make_flight_recorder,
+            make_launch_recorder,
+            make_timeseries,
+            register_default_series,
         )
 
         store = self.stats_manager.store
@@ -320,6 +325,21 @@ class Runner:
             self.flight.register_stats(store)
             if hasattr(self.cache, "flight"):
                 self.cache.flight = self.flight
+
+        # Launch flight recorder (observability/launches.py;
+        # docs/OBSERVABILITY.md "Launch recorder"): one ring record per
+        # device batch, stamped on the dispatcher threads — the
+        # per-launch analog of the decision ring above.  Only the TPU
+        # backends have dispatchers to instrument.
+        self.launches = make_launch_recorder(s.launch_recorder_size)
+        if self.launches is not None:
+            if hasattr(self.cache, "attach_launch_recorder"):
+                self.cache.attach_launch_recorder(self.launches)
+                self.launches.register_stats(store)
+            else:
+                # No dispatch path to record: keep the route absent
+                # rather than serving an eternally-empty ring.
+                self.launches = None
 
         # Lifecycle event journal (observability/events.py;
         # docs/OBSERVABILITY.md "Event journal").  One process-wide
@@ -379,6 +399,26 @@ class Runner:
                 self.cache, "promotion"
             ):
                 self.cache.promotion = self.overload.promotion
+
+        # In-process time-series store (observability/timeseries.py;
+        # docs/OBSERVABILITY.md "Time-series store"): bounded capacity
+        # / latency history behind /debug/timeseries, incident
+        # captures and the /fleet.json sparkline summaries.  Series
+        # registration happens HERE, before the sampler starts.
+        self.timeseries = make_timeseries(
+            s.tsdb_interval_s, s.tsdb_retention_s
+        )
+        if self.timeseries is not None:
+            register_default_series(
+                self.timeseries,
+                store,
+                cache=self.cache,
+                launches=self.launches,
+                overload=self.overload,
+                local_cache=local_cache,
+            )
+            self.timeseries.register_stats(store)
+            self.timeseries.start()
 
         if s.tpu_warmup and hasattr(self.cache, "warmup"):
             logger.warning("warming up kernel shapes (TPU_WARMUP=true)...")
@@ -464,6 +504,7 @@ class Runner:
             cooldown_s=s.anomaly_cooldown_s,
             overload=self.overload,
             events=self.events,
+            timeseries=self.timeseries,
         )
         self.detectors.register_stats(store)
         self.detectors.start()
@@ -528,6 +569,8 @@ class Runner:
             flight=self.flight,
             cluster_handoff_enabled=s.cluster_handoff_enabled,
             events=self.events,
+            launches=self.launches,
+            timeseries=self.timeseries,
         )
         add_healthcheck(self.debug_server, self.health)
         self.debug_server.start()
@@ -610,6 +653,8 @@ class Runner:
             self.runtime.stop()
         if self.detectors is not None:
             self.detectors.stop()
+        if self.timeseries is not None:
+            self.timeseries.stop()
         if self.statsd is not None:
             self.statsd.stop()
         if self.cache is not None and hasattr(self.cache, "close"):

@@ -250,6 +250,14 @@ ENDPOINT_BLURBS = {
         "lifecycle event journal, time-ordered with ?since= cursor "
         "(JSON)"
     ),
+    "/debug/launches": (
+        "per-launch device-batch timeline: phase durations + "
+        "coalescing, ?since= cursor (JSON)"
+    ),
+    "/debug/timeseries": (
+        "in-process capacity/latency history "
+        "?since=&series=a,b (or ?summary=1 digest) (JSON)"
+    ),
     "/debug/incidents": "captured anomaly incident reports (JSON)",
     "/debug/slo": "per-domain SLI / error-budget burn summary (JSON)",
     "/debug/overload": (

@@ -257,6 +257,8 @@ def add_debug_routes(
     flight=None,
     cluster_handoff_enabled: bool = False,
     events=None,
+    launches=None,
+    timeseries=None,
 ) -> None:
     """/stats, /rlconfig, /metrics, /debug/* (server_impl.go:254-261,
     runner.go:117-124).  ``profiling_enabled`` (the DEBUG_PROFILING
@@ -269,7 +271,13 @@ def add_debug_routes(
     is always on); ``events`` (observability/events.py,
     EVENT_JOURNAL_SIZE) opens /debug/events — the replica's lifecycle
     timeline, with a ``since=`` seq cursor for pollers (the proxy's
-    /fleet.json scrape resumes where it left off)."""
+    /fleet.json scrape resumes where it left off); ``launches``
+    (observability/launches.py, LAUNCH_RECORDER_SIZE) opens
+    /debug/launches — the per-device-batch dispatch timeline, same
+    cursor contract; ``timeseries`` (observability/timeseries.py,
+    TSDB_INTERVAL_S) opens /debug/timeseries — the in-process
+    capacity/latency history (``?since=&series=``, or ``?summary=1``
+    for the per-series last/avg/max digest /fleet.json scrapes)."""
 
     def stats(h) -> None:
         lines = []
@@ -574,7 +582,88 @@ def add_debug_routes(
             content_type="application/json",
         )
 
+    def launches_view(h) -> None:
+        # Per-launch dispatch timeline (observability/launches.py):
+        # one row per device batch with phase durations + coalescing
+        # counts.  ?since=<seq> is the /debug/events cursor contract.
+        if launches is None:
+            h._reply(
+                404, b"launch recorder disabled (LAUNCH_RECORDER_SIZE=0)\n"
+            )
+            return
+        from urllib.parse import parse_qs, urlsplit
+
+        qs = parse_qs(urlsplit(h.path).query)
+        try:
+            since = int(qs.get("since", ["0"])[0])
+            limit = int(qs.get("limit", ["0"])[0]) or None
+        except ValueError:
+            h._reply(400, b"bad since=/limit= (want integers)\n")
+            return
+        h._reply(
+            200,
+            json.dumps(
+                {
+                    "stamped": launches.stamped(),
+                    "capacity": launches.size,
+                    "p99_launch_ns": launches.p99_launch_ns(),
+                    "coalesce_ratio": launches.coalesce_ratio(),
+                    "items_by_algo": launches.items_by_algo(),
+                    "launches": launches.snapshot_dicts(
+                        since=since, limit=limit
+                    ),
+                }
+            ).encode(),
+            content_type="application/json",
+        )
+
+    def timeseries_view(h) -> None:
+        # In-process capacity/latency history (observability/
+        # timeseries.py).  ?since=<seq> resumes a poller; ?series=a,b
+        # filters columns; ?summary=1 returns the bounded per-series
+        # {last,avg,max} digest (the /fleet.json scrape shape).
+        if timeseries is None:
+            h._reply(
+                404, b"time-series store disabled (TSDB_INTERVAL_S=0)\n"
+            )
+            return
+        from urllib.parse import parse_qs, urlsplit
+
+        qs = parse_qs(urlsplit(h.path).query)
+        if qs.get("summary", ["0"])[0] not in ("0", ""):
+            h._reply(
+                200,
+                json.dumps(
+                    {
+                        "interval_s": timeseries.interval_s,
+                        "summary": timeseries.summary(),
+                    }
+                ).encode(),
+                content_type="application/json",
+            )
+            return
+        try:
+            since = int(qs.get("since", ["0"])[0])
+        except ValueError:
+            h._reply(400, b"bad since= cursor (want an integer)\n")
+            return
+        series = None
+        if "series" in qs:
+            series = [
+                name
+                for chunk in qs["series"]
+                for name in chunk.split(",")
+                if name
+            ]
+        h._reply(
+            200,
+            json.dumps(timeseries.snapshot(since=since, series=series)).encode(),
+            content_type="application/json",
+        )
+
     server.add_route("GET", "/debug/events", events_view)
+    server.add_route("GET", "/debug/launches", launches_view)
+    server.add_route("GET", "/debug/timeseries", timeseries_view)
     server.add_route("GET", "/debug/faults", faults)
     server.add_route("GET", "/debug/incidents", incidents)
     server.add_route("GET", "/debug/slo", slo_summary)

@@ -275,6 +275,17 @@ class Settings:
     # Optional JSONL mirror of every journal event (append-only; the
     # incident-dir analog for the timeline).  Empty disables.
     event_journal_jsonl: str = ""
+    # Launch flight recorder (observability/launches.py): slots in the
+    # per-LAUNCH device-batch ring served at /debug/launches.  0
+    # disables recording entirely (the dispatch path pays one
+    # attribute load + branch per launch).
+    launch_recorder_size: int = 1024
+    # In-process time-series store (observability/timeseries.py):
+    # sampler cadence and history depth behind /debug/timeseries and
+    # the /fleet.json sparkline summaries.  TSDB_INTERVAL_S=0 disables
+    # the store entirely (no sampler thread, no history).
+    tsdb_interval_s: float = 5.0
+    tsdb_retention_s: float = 3600.0
     # Anomaly detectors (observability/detectors.py): sampler cadence;
     # 0 disables the sampler thread (and incident capture).  The
     # shared knobs below tune the EWMA-baselined triggers — see
@@ -459,6 +470,9 @@ def new_settings() -> Settings:
         flight_corr_enabled=_env_bool("FLIGHT_CORR_ENABLED", False),
         event_journal_size=_env_int("EVENT_JOURNAL_SIZE", 1024),
         event_journal_jsonl=_env_str("EVENT_JOURNAL_JSONL", ""),
+        launch_recorder_size=_env_int("LAUNCH_RECORDER_SIZE", 1024),
+        tsdb_interval_s=_env_float("TSDB_INTERVAL_S", 5.0),
+        tsdb_retention_s=_env_float("TSDB_RETENTION_S", 3600.0),
         anomaly_interval_s=_env_float("ANOMALY_INTERVAL_S", 5.0),
         anomaly_spike_factor=_env_float("ANOMALY_SPIKE_FACTOR", 4.0),
         anomaly_min_samples=_env_int("ANOMALY_MIN_SAMPLES", 20),

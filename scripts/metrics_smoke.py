@@ -27,7 +27,13 @@ full gRPC stack, then asserts:
   ring snapshot) lands in INCIDENT_DIR and round-trips through
   GET /debug/incidents, the per-domain ratelimit.tpu.slo.* burn-rate
   family shows on /metrics, and GET /debug/slo + the generated
-  GET /debug/ index are well-formed.
+  GET /debug/ index are well-formed;
+- the performance observability plane: GET /debug/launches carries
+  real dispatcher-stamped device batches with a resumable ?since=
+  cursor, a driven timeseries tick lands behind GET /debug/timeseries
+  (rows + ?summary=1 digest), the ratelimit.tpu.launch.* and
+  ratelimit.tsdb.* families render on /metrics, and both endpoints
+  appear (blurbed) in the GET /debug/ index.
 
 Exit 0 on success; any assertion prints context and exits 1.
 """
@@ -378,10 +384,64 @@ def main() -> int:
             assert slo["domains"]["smoke"]["cumulative"]["over_limit"] >= 10
             assert slo["domains"]["smoke"]["window"]["requests"] > 0
 
-            # The generated /debug/ index lists every GET endpoint.
+            # --- performance observability plane ----------------------
+            # Every gRPC request above crossed the dispatcher, so the
+            # launch flight recorder has stamped real device batches.
+            launches = json.loads(get("/debug/launches"))
+            assert launches["stamped"] >= 1, launches
+            assert launches["capacity"] == 1024, launches
+            assert launches["coalesce_ratio"] >= 1.0, launches
+            row = launches["launches"][-1]
+            assert row["items"] >= 1 and row["launch_us"] >= 0, row
+            # corr joins only render under FLIGHT_CORR_ENABLED (off
+            # here) — rows must then omit the field, not carry zeros.
+            assert "corr" not in row, row
+            cursor = row["seq"]
+            drained = json.loads(get(f"/debug/launches?since={cursor}"))
+            assert drained["launches"] == [], drained
+
+            # The tsdb sampler runs on its own 5s cadence; one driven
+            # tick (same seam the anomaly scenario uses) lands a row
+            # deterministically.
+            runner.timeseries.tick()
+            tsdb = json.loads(get("/debug/timeseries"))
+            assert tsdb["seqs"], tsdb
+            assert "rss_mb" in tsdb["series"], sorted(tsdb["series"])
+            assert "launches_per_s" in tsdb["series"], sorted(tsdb["series"])
+            assert tsdb["series"]["rss_mb"][-1] > 0, tsdb["series"]["rss_mb"]
+            digest = json.loads(get("/debug/timeseries?summary=1"))
+            assert digest["interval_s"] == 5.0, digest
+            assert digest["summary"]["rss_mb"]["last"] > 0, digest
+
+            # Both stores export their stats families.
+            metrics = get("/metrics")
+            for family in (
+                "ratelimit_tpu_launch_capacity",
+                "ratelimit_tpu_launch_rate",
+                "ratelimit_tpu_launch_p99_launch_ns",
+                "ratelimit_tpu_launch_coalesce_ratio",
+                "ratelimit_tsdb_series",
+                "ratelimit_tsdb_capacity",
+                "ratelimit_tsdb_ticks",
+            ):
+                assert family in metrics, family
+
+            # The generated /debug/ index lists every GET endpoint,
+            # with a one-line blurb for the new surfaces.
             index = get("/debug/")
-            for path in ("/debug/incidents", "/debug/slo", "/debug/tracez"):
+            for path in (
+                "/debug/incidents",
+                "/debug/slo",
+                "/debug/tracez",
+                "/debug/launches",
+                "/debug/timeseries",
+            ):
                 assert path in index, (path, index)
+            for blurb in (
+                "per-launch device-batch timeline",
+                "in-process capacity/latency history",
+            ):
+                assert blurb in index, (blurb, index)
 
             print(
                 json.dumps(

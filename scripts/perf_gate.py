@@ -1,0 +1,173 @@
+"""Perf-regression ratchet (`make perf-gate`).
+
+Machine-checks the committed perf artifacts (benchmarks/results/*.json,
+each written by a profile_host_path.py / bench leg) against the
+committed budget file benchmarks/perf_budget.json, the same shape of
+contract the static-analysis baseline gives lint findings: numbers may
+only get better; getting worse fails CI with the offending metric
+named.
+
+Each budget entry names one metric inside one artifact:
+
+    {"artifact": "launches_overhead.json",
+     "metric": "total_overhead_us_per_req_enabled",
+     "max": 0.5,                  # hard ceiling (hand-set, never
+                                  # raised by tooling)
+     "measured": 0.296}           # value when last baselined
+                                  # (--write-baseline refreshes it)
+
+or asserts an exact value (parity/engagement booleans):
+
+    {"artifact": "flight_overhead.json",
+     "metric": "decisions_identical_on_off", "equals": true}
+
+Checks, in gate order:
+
+1. the artifact exists and parses (a deleted artifact is a regression,
+   not a skip);
+2. ``metric`` resolves (dotted path for nested artifacts, e.g.
+   ``resolution.resolved_us_per_req``);
+3. ``equals`` entries match exactly;
+4. ``max`` entries satisfy ``value <= max``;
+5. with ``--fail-on-new`` (the CI mode), numeric entries additionally
+   satisfy ``value <= measured * (1 + tolerance)`` — the creep
+   ratchet: a rerun that regresses >25% vs its own baseline fails
+   even while still under the hard ceiling.
+
+``--write-baseline`` refreshes every entry's ``measured`` from the
+current artifacts (run after intentionally regenerating them) but
+NEVER touches ``max``: loosening a ceiling is a reviewed hand edit of
+perf_budget.json, exactly like loosening the lint baseline.
+
+Exit 0 when every check passes; otherwise prints one line per
+violation and exits 1.  Importable: tests drive :func:`evaluate`
+against doctored artifact dirs to prove an injected regression fails.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUDGET_PATH = os.path.join(REPO, "benchmarks", "perf_budget.json")
+RESULTS_DIR = os.path.join(REPO, "benchmarks", "results")
+
+#: --fail-on-new creep tolerance vs the baselined ``measured`` value:
+#: microbenchmarks on shared CI hosts jitter; 25% is far above run
+#: noise for the medians/best-ofs the artifacts record and far below
+#: the 2-10x a genuinely regressed seam shows.
+TOLERANCE = 0.25
+
+
+def _resolve(doc, path: str):
+    """Dotted-path lookup (``resolution.resolved_us_per_req``);
+    raises KeyError with the full path on a miss."""
+    cur = doc
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            raise KeyError(path)
+        cur = cur[part]
+    return cur
+
+
+def evaluate(
+    budget: dict,
+    results_dir: str = RESULTS_DIR,
+    fail_on_new: bool = False,
+) -> List[str]:
+    """Run every check; return violation strings (empty = green).
+    Pure function of (budget, artifact dir) so tests can inject a
+    regressed artifact and assert the gate names it."""
+    violations: List[str] = []
+    docs: dict = {}
+    for check in budget.get("checks", []):
+        art = check["artifact"]
+        metric = check["metric"]
+        where = f"{art}:{metric}"
+        if art not in docs:
+            path = os.path.join(results_dir, art)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    docs[art] = json.load(f)
+            except (OSError, ValueError) as e:
+                docs[art] = None
+                violations.append(f"{art}: unreadable artifact ({e})")
+        doc = docs[art]
+        if doc is None:
+            continue
+        try:
+            value = _resolve(doc, metric)
+        except KeyError:
+            violations.append(f"{where}: metric missing from artifact")
+            continue
+        if "equals" in check:
+            if value != check["equals"]:
+                violations.append(
+                    f"{where}: expected {check['equals']!r}, got {value!r}"
+                )
+            continue
+        ceiling = check["max"]
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            violations.append(f"{where}: non-numeric value {value!r}")
+            continue
+        if value > ceiling:
+            violations.append(
+                f"{where}: {value:.4g} over budget max {ceiling:.4g}"
+            )
+            continue
+        measured = check.get("measured")
+        if fail_on_new and isinstance(measured, (int, float)):
+            creep = measured * (1.0 + TOLERANCE)
+            if value > creep and value > measured + 0.05:
+                violations.append(
+                    f"{where}: {value:.4g} regressed vs baseline "
+                    f"{measured:.4g} (tolerance {TOLERANCE:.0%})"
+                )
+    return violations
+
+
+def write_baseline(budget: dict, results_dir: str = RESULTS_DIR) -> dict:
+    """Refresh ``measured`` on every numeric check from the current
+    artifacts (``max`` is deliberately untouched)."""
+    for check in budget.get("checks", []):
+        if "max" not in check:
+            continue
+        path = os.path.join(results_dir, check["artifact"])
+        try:
+            with open(path, encoding="utf-8") as f:
+                value = _resolve(json.load(f), check["metric"])
+        except (OSError, ValueError, KeyError):
+            continue
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            check["measured"] = round(float(value), 6)
+    return budget
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    with open(BUDGET_PATH, encoding="utf-8") as f:
+        budget = json.load(f)
+    if "--write-baseline" in argv:
+        budget = write_baseline(budget)
+        with open(BUDGET_PATH, "w", encoding="utf-8") as f:
+            json.dump(budget, f, indent=2)
+            f.write("\n")
+        print(f"wrote {BUDGET_PATH}")
+        return 0
+    fail_on_new = "--fail-on-new" in argv
+    violations = evaluate(budget, fail_on_new=fail_on_new)
+    n = len(budget.get("checks", []))
+    if violations:
+        for v in violations:
+            print(f"PERF-GATE FAIL {v}")
+        print(f"perf-gate: {len(violations)} violation(s) in {n} check(s)")
+        return 1
+    print(f"perf-gate: {n} check(s) green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

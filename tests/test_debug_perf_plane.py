@@ -1,0 +1,232 @@
+"""HTTP surfaces of the performance observability plane
+(/debug/launches, /debug/timeseries) and the perf-regression gate
+(scripts/perf_gate.py): cursor contracts, disabled-mode 404s, bad
+input 400s, and the injected-regression failure path."""
+
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ratelimit_tpu.observability import (
+    OUTCOME_OK,
+    TimeSeriesStore,
+    make_launch_recorder,
+)
+from ratelimit_tpu.server.http_server import HttpServer, add_debug_routes
+from ratelimit_tpu.stats.manager import StatsStore
+from ratelimit_tpu.utils.time import FakeMonotonicClock
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "scripts")
+)
+import perf_gate  # noqa: E402
+
+
+def _get(port, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    )
+
+
+# ---------------------------------------------------------------------------
+# GET /debug/launches
+# ---------------------------------------------------------------------------
+
+
+def test_debug_launches_endpoint_cursor_and_families():
+    lr = make_launch_recorder(32, clock=FakeMonotonicClock(1.0))
+    lr.record(0, 0, 4, 2, 3, 1_000, 300_000, 80_000, OUTCOME_OK, 0xAB)
+    lr.record(1, 0, 2, 2, 2, 2_000, 400_000, 90_000, OUTCOME_OK)
+    server = HttpServer("127.0.0.1", 0, name="launch-dbg")
+    add_debug_routes(server, StatsStore(), launches=lr)
+    server.start()
+    try:
+        with _get(server.bound_port, "/debug/launches") as r:
+            body = json.loads(r.read())
+        assert body["stamped"] == 2
+        assert body["capacity"] == 32
+        assert body["coalesce_ratio"] == 2.0
+        assert body["p99_launch_ns"] > 0
+        assert body["items_by_algo"]["fixed_window"] == 4
+        launches = body["launches"]
+        assert [e["seq"] for e in launches] == [1, 2]
+        assert launches[0]["corr"] == f"{0xAB:016x}"
+        assert launches[0]["outcome"] == "ok"
+        cursor = launches[-1]["seq"]
+        with _get(
+            server.bound_port, f"/debug/launches?since={cursor}"
+        ) as r:
+            assert json.loads(r.read())["launches"] == []
+        with _get(server.bound_port, "/debug/launches?limit=1") as r:
+            got = json.loads(r.read())["launches"]
+        assert [e["seq"] for e in got] == [2]
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(server.bound_port, "/debug/launches?since=banana")
+        assert e.value.code == 400
+    finally:
+        server.stop()
+
+
+def test_debug_launches_404_when_disabled():
+    server = HttpServer("127.0.0.1", 0, name="launch-dbg-off")
+    add_debug_routes(server, StatsStore())
+    server.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(server.bound_port, "/debug/launches")
+        assert e.value.code == 404
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# GET /debug/timeseries
+# ---------------------------------------------------------------------------
+
+
+def _ticked_store():
+    clock = FakeMonotonicClock(10.0)
+    ts = TimeSeriesStore(5.0, 60.0, clock=clock, wall=lambda: 1000.0)
+    val = [3.0]
+    ts.add_gauge("queue_depth", lambda: val[0])
+    ts.tick()
+    val[0] = 7.0
+    clock.advance(5.0)
+    ts.tick()
+    return ts
+
+
+def test_debug_timeseries_endpoint_cursor_filter_summary():
+    ts = _ticked_store()
+    server = HttpServer("127.0.0.1", 0, name="tsdb-dbg")
+    add_debug_routes(server, StatsStore(), timeseries=ts)
+    server.start()
+    try:
+        with _get(server.bound_port, "/debug/timeseries") as r:
+            body = json.loads(r.read())
+        assert body["seqs"] == [1, 2]
+        assert body["series"]["queue_depth"] == [3.0, 7.0]
+        cursor = body["seq"]
+        with _get(
+            server.bound_port,
+            f"/debug/timeseries?since={cursor}&series=queue_depth",
+        ) as r:
+            assert json.loads(r.read())["seqs"] == []
+        with _get(server.bound_port, "/debug/timeseries?summary=1") as r:
+            digest = json.loads(r.read())
+        assert digest["interval_s"] == 5.0
+        assert digest["summary"]["queue_depth"]["last"] == 7.0
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(server.bound_port, "/debug/timeseries?since=banana")
+        assert e.value.code == 400
+    finally:
+        server.stop()
+
+
+def test_debug_timeseries_404_when_disabled():
+    server = HttpServer("127.0.0.1", 0, name="tsdb-dbg-off")
+    add_debug_routes(server, StatsStore())
+    server.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(server.bound_port, "/debug/timeseries")
+        assert e.value.code == 404
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# scripts/perf_gate.py
+# ---------------------------------------------------------------------------
+
+
+def test_perf_gate_green_at_head():
+    """The committed budget file must be green against the committed
+    artifacts — the exact check `make ci` runs."""
+    with open(perf_gate.BUDGET_PATH, encoding="utf-8") as f:
+        budget = json.load(f)
+    assert budget["checks"], "empty budget file"
+    assert perf_gate.evaluate(budget, fail_on_new=True) == []
+
+
+def _write(dirpath, name, doc):
+    with open(os.path.join(dirpath, name), "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+
+
+def test_perf_gate_fails_on_injected_regression(tmp_path):
+    """A regressed artifact (over ceiling, over creep tolerance,
+    parity flipped, metric deleted, artifact deleted) must each fail
+    with the metric named."""
+    budget = {
+        "checks": [
+            {
+                "artifact": "a.json",
+                "metric": "total_us",
+                "max": 0.5,
+                "measured": 0.3,
+            },
+            {
+                "artifact": "a.json",
+                "metric": "nested.warm_us",
+                "max": 15.0,
+                "measured": 10.0,
+            },
+            {"artifact": "a.json", "metric": "parity", "equals": True},
+        ]
+    }
+    d = str(tmp_path)
+
+    _write(d, "a.json", {"total_us": 0.3, "nested": {"warm_us": 10.0},
+                         "parity": True})
+    assert perf_gate.evaluate(budget, results_dir=d, fail_on_new=True) == []
+
+    # Over the hard ceiling.
+    _write(d, "a.json", {"total_us": 0.9, "nested": {"warm_us": 10.0},
+                         "parity": True})
+    v = perf_gate.evaluate(budget, results_dir=d)
+    assert len(v) == 1 and "total_us" in v[0] and "over budget" in v[0]
+
+    # Under the ceiling but >25% worse than baseline: only
+    # --fail-on-new (the CI mode) catches the creep.
+    _write(d, "a.json", {"total_us": 0.45, "nested": {"warm_us": 10.0},
+                         "parity": True})
+    assert perf_gate.evaluate(budget, results_dir=d) == []
+    v = perf_gate.evaluate(budget, results_dir=d, fail_on_new=True)
+    assert len(v) == 1 and "regressed vs baseline" in v[0]
+
+    # Parity flip.
+    _write(d, "a.json", {"total_us": 0.3, "nested": {"warm_us": 10.0},
+                         "parity": False})
+    v = perf_gate.evaluate(budget, results_dir=d)
+    assert len(v) == 1 and "parity" in v[0]
+
+    # Metric vanished from the artifact.
+    _write(d, "a.json", {"total_us": 0.3, "parity": True})
+    v = perf_gate.evaluate(budget, results_dir=d)
+    assert len(v) == 1 and "nested.warm_us" in v[0]
+
+    # Artifact deleted: every check on it is a single named violation.
+    os.remove(os.path.join(d, "a.json"))
+    v = perf_gate.evaluate(budget, results_dir=d)
+    assert len(v) == 1 and "unreadable artifact" in v[0]
+
+
+def test_perf_gate_write_baseline_updates_measured_not_max(tmp_path):
+    budget = {
+        "checks": [
+            {"artifact": "a.json", "metric": "total_us", "max": 0.5,
+             "measured": 0.3},
+            {"artifact": "a.json", "metric": "parity", "equals": True},
+        ]
+    }
+    d = str(tmp_path)
+    _write(d, "a.json", {"total_us": 0.42, "parity": True})
+    out = perf_gate.write_baseline(budget, results_dir=d)
+    assert out["checks"][0]["measured"] == 0.42
+    assert out["checks"][0]["max"] == 0.5  # ceilings are hand-edited only
+    assert "measured" not in out["checks"][1]

@@ -224,11 +224,34 @@ class _Holder:
         return self._stats
 
 
+def _ts_summary_body(rid):
+    """A LIVE TimeSeriesStore per replica (real ticks on a fake
+    clock), serialized exactly as /debug/timeseries?summary=1 serves
+    it — so the fleet merge is fed by the real summary shape, not a
+    hand-written imitation."""
+    from ratelimit_tpu.observability import TimeSeriesStore
+
+    clock = FakeMonotonicClock(100.0)
+    ts = TimeSeriesStore(5.0, 60.0, clock=clock, wall=lambda: 1000.0)
+    rss = 200.0 if rid == "r0:1" else 350.0
+    total = [0]
+    ts.add_gauge("rss_mb", lambda: rss)
+    ts.add_counter("decisions_per_s", lambda: total[0])
+    ts.tick()
+    total[0] = 5_000
+    clock.advance(5.0)
+    ts.tick()
+    return json.dumps(
+        {"interval_s": ts.interval_s, "summary": ts.summary()}
+    ).encode()
+
+
 def _replica_bodies(rid):
     """One replica's debug surfaces, parameterized so merges have
     something to disagree about."""
     burn = 2.0 if rid == "r1:2" else 0.5
     return {
+        "/debug/timeseries?summary=1": _ts_summary_body(rid),
         "/metrics": b"# HELP ...\n",
         "/debug/slo": json.dumps(
             {
@@ -358,6 +381,28 @@ def test_fleet_merges_slo_hotkeys_faults_events():
     ]
 
     assert fleet["cluster"]["r0:1"]["handoff_enabled"] is True
+
+
+def test_fleet_merges_timeseries_summaries_from_live_replicas():
+    """Two replicas' LIVE TimeSeriesStore digests ride the scrape and
+    land per-replica in /fleet.json — the capacity history stays
+    attributed, never averaged away."""
+    admin = {"r0:1": "http://h0:6070", "r1:2": "http://h1:6070"}
+    agg, _ = _make_agg(admin)
+    holder = _Holder({"replicas": 2, "replica_states": []})
+    fleet = agg.fleet(holder)
+
+    assert set(fleet["timeseries"]) == {"r0:1", "r1:2"}
+    r0 = fleet["timeseries"]["r0:1"]
+    r1 = fleet["timeseries"]["r1:2"]
+    assert r0["interval_s"] == 5.0
+    assert r0["summary"]["rss_mb"]["last"] == 200.0
+    assert r1["summary"]["rss_mb"]["last"] == 350.0
+    # The counter rate came from two real ticks: 5000 over 5s.
+    assert r0["summary"]["decisions_per_s"]["last"] == 1000.0
+    # NaN rows (the seeding tick) must already be None-folded — the
+    # merge re-serializes to JSON.
+    json.dumps(fleet["timeseries"])
 
 
 def test_fleet_skips_open_circuits_and_degrades_per_endpoint():

@@ -1,0 +1,188 @@
+"""In-process time-series store (observability/timeseries.py): the
+deterministic fake-clock tick() seam — gauge/counter/histogram source
+kinds, retention wraparound, the ``since=`` cursor + series filter,
+summaries, error-resilient sources, and the sampler thread."""
+
+import time
+
+import pytest
+
+from ratelimit_tpu.observability import (
+    TimeSeriesStore,
+    make_timeseries,
+    register_default_series,
+)
+from ratelimit_tpu.stats.manager import StatsStore
+from ratelimit_tpu.utils.time import FakeMonotonicClock
+
+
+def _store(interval=5.0, retention=30.0, start=100.0, wall_start=1000.0):
+    clock = FakeMonotonicClock(start)
+    wall = [wall_start]
+    ts = TimeSeriesStore(
+        interval, retention, clock=clock, wall=lambda: wall[0]
+    )
+    return ts, clock, wall
+
+
+def test_zero_interval_disables():
+    assert make_timeseries(0, 3600) is None
+    assert make_timeseries(-1, 3600) is None
+    assert isinstance(make_timeseries(5, 3600), TimeSeriesStore)
+    with pytest.raises(ValueError):
+        TimeSeriesStore(0, 3600)
+
+
+def test_duplicate_series_rejected():
+    ts, _, _ = _store()
+    ts.add_gauge("x", lambda: 1)
+    with pytest.raises(ValueError):
+        ts.add_counter("x", lambda: 1)
+
+
+def test_gauge_sampled_verbatim_counter_differentiated():
+    ts, clock, wall = _store()
+    depth = [7]
+    total = [0]
+    ts.add_gauge("queue_depth", lambda: depth[0])
+    ts.add_counter("decisions_per_s", lambda: total[0])
+    ts.tick()  # seeding tick: gauge lands, rate is NaN -> None
+    depth[0] = 9
+    total[0] = 500
+    clock.advance(5.0)
+    wall[0] += 5.0
+    ts.tick()
+    snap = ts.snapshot()
+    assert snap["seqs"] == [1, 2]
+    assert snap["ts_unix"] == [1000.0, 1005.0]
+    assert snap["series"]["queue_depth"] == [7.0, 9.0]
+    assert snap["series"]["decisions_per_s"] == [None, 100.0]
+
+
+def test_histogram_delta_p99_is_per_tick():
+    ts, clock, _ = _store()
+    store = StatsStore()
+    hist = store.histogram("svc.response_ms")
+    ts.add_histogram_p99("p99_response_ms", hist)
+    hist.observe(100.0)
+    ts.tick()  # seeding tick: no previous counts -> None
+    clock.advance(5.0)
+    for _ in range(50):
+        hist.observe(1.0)  # this tick's traffic is all fast...
+    ts.tick()
+    snap = ts.snapshot()
+    p99 = snap["series"]["p99_response_ms"]
+    assert p99[0] is None
+    # ...so the delta-p99 reflects the 1ms burst, not the old 100ms
+    # observation still sitting in the cumulative counts.
+    assert p99[1] is not None and p99[1] <= 2.5
+    clock.advance(5.0)
+    ts.tick()  # nothing observed since -> None again
+    assert ts.snapshot()["series"]["p99_response_ms"][-1] is None
+
+
+def test_retention_wraparound_keeps_newest_window():
+    ts, clock, wall = _store(interval=5.0, retention=30.0)  # 6 slots
+    tick_no = [0]
+    ts.add_gauge("v", lambda: tick_no[0])
+    for i in range(10):
+        tick_no[0] = i
+        ts.tick()
+        clock.advance(5.0)
+        wall[0] += 5.0
+    snap = ts.snapshot()
+    assert ts.slots == 6
+    assert snap["seqs"] == [5, 6, 7, 8, 9, 10]
+    assert snap["series"]["v"] == [4.0, 5.0, 6.0, 7.0, 8.0, 9.0]
+
+
+def test_since_cursor_and_series_filter():
+    ts, clock, _ = _store()
+    ts.add_gauge("a", lambda: 1)
+    ts.add_gauge("b", lambda: 2)
+    ts.tick()
+    clock.advance(5.0)
+    ts.tick()
+    snap = ts.snapshot()
+    cursor = snap["seq"]
+    assert cursor == 2
+    assert ts.snapshot(since=cursor)["seqs"] == []
+    clock.advance(5.0)
+    ts.tick()
+    nxt = ts.snapshot(since=cursor, series=["b", "nope"])
+    assert nxt["seqs"] == [3]
+    assert set(nxt["series"]) == {"b"}
+    assert nxt["series"]["b"] == [2.0]
+
+
+def test_summary_last_avg_max_and_empty_series():
+    ts, clock, _ = _store()
+    vals = iter([10.0, 30.0, 20.0])
+    ts.add_gauge("g", lambda: next(vals))
+    ts.add_gauge("empty", lambda: 1 / 0)  # never lands a live value
+    for _ in range(3):
+        ts.tick()
+        clock.advance(5.0)
+    s = ts.summary()
+    assert s["g"] == {"last": 20.0, "avg": 20.0, "max": 30.0}
+    assert s["empty"] == {"last": None, "avg": None, "max": None}
+
+
+def test_broken_source_lands_nan_not_raise():
+    ts, clock, _ = _store()
+    ts.add_gauge("bad", lambda: 1 / 0)
+    ts.add_counter("bad_rate", lambda: 1 / 0)
+    ts.add_gauge("good", lambda: 5)
+    ts.tick()
+    clock.advance(5.0)
+    ts.tick()
+    snap = ts.snapshot()
+    assert snap["series"]["bad"] == [None, None]
+    assert snap["series"]["bad_rate"] == [None, None]
+    assert snap["series"]["good"] == [5.0, 5.0]
+
+
+def test_register_stats_family():
+    ts, clock, _ = _store()
+    ts.add_gauge("g", lambda: 1)
+    store = StatsStore()
+    ts.register_stats(store)
+    ts.tick()
+    clock.advance(5.0)
+    ts.tick()
+    assert store.gauges()["ratelimit.tsdb.series"] == 1
+    assert store.gauges()["ratelimit.tsdb.capacity"] == ts.slots
+    assert store.counters()["ratelimit.tsdb.ticks"] == 2
+
+
+def test_default_series_registration_names():
+    store = StatsStore()
+    ts, _, _ = _store()
+    register_default_series(ts, store)
+    names = ts.series_names()
+    assert "decisions_per_s" in names
+    assert "p99_decode_ms" in names
+    assert "p99_service_ms" in names
+    assert "p99_serialize_ms" in names
+    assert "p99_response_ms" in names
+    assert "rss_mb" in names
+    # No cache/recorder wired -> their series simply don't exist.
+    assert "launches_per_s" not in names
+    assert "queue_depth" not in names
+
+
+def test_sampler_thread_ticks_and_stops():
+    ts = TimeSeriesStore(0.01, 1.0)
+    ts.add_gauge("g", lambda: 1)
+    ts.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while ts.snapshot()["seq"] < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        ts.stop()
+    seq = ts.snapshot()["seq"]
+    assert seq >= 3
+    time.sleep(0.05)
+    assert ts.snapshot()["seq"] == seq  # stopped means stopped
+    ts.stop()  # idempotent
